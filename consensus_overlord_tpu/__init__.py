@@ -1,0 +1,23 @@
+"""consensus_overlord_tpu — a TPU-native BFT consensus framework.
+
+A brand-new framework with the capabilities of cita-cloud/consensus_overlord
+(reference: /root/reference, surveyed in SURVEY.md): a CITA-Cloud-compatible
+consensus microservice built around an Overlord-style aggregated-signature BFT
+state machine, with the signature-heavy hot path (vote verification, signature
+aggregation, aggregate verification — reference src/consensus.rs:385-463)
+lifted onto TPU as batched JAX/Pallas computations.
+
+Layering (mirrors SURVEY.md §7):
+  core/     — wire types, RLP codec, SM3 hashing, voter bitmaps
+  crypto/   — the Crypto port: CPU oracle (pure-Python BLS12-381) and the
+              TPU backends (limb-decomposed field arithmetic, batched
+              Ed25519/BLS verification under jit/vmap, Pallas kernels)
+  engine/   — the Overlord-equivalent SMR state machine + WAL
+  ports/    — Chain / Network / Wal / Crypto protocol definitions
+  service/  — gRPC shell (ConsensusService / NetworkMsgHandler / Health)
+  sim/      — in-process multi-validator simulation harness
+  parallel/ — device-mesh sharding of crypto batches (pjit / shard_map)
+  obs/      — config, logging, metrics, tracing
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+"""Core wire types, RLP codec, SM3 hashing, voter bitmaps."""
+
+from .sm3 import sm3_hash, HASH_BYTES_LEN  # noqa: F401
+from . import rlp, types, bitmap  # noqa: F401

@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Multi-chip sharding is tested on a virtual 8-device CPU mesh: real TPU
+hardware in the dev loop is a single chip, so tests force the CPU platform
+with 8 host devices before JAX initializes (see task spec / SURVEY.md §7
+build order step 6).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,180 @@
+"""Core layer tests: RLP, SM3, wire types, voter bitmaps."""
+
+import pytest
+
+from consensus_overlord_tpu.core import rlp, sm3, bitmap
+from consensus_overlord_tpu.core.types import (
+    AggregatedSignature,
+    AggregatedVote,
+    Choke,
+    DurationConfig,
+    Node,
+    Proof,
+    Proposal,
+    SignedChoke,
+    SignedProposal,
+    SignedVote,
+    Vote,
+    VoteType,
+    validator_to_origin,
+    validators_to_nodes,
+)
+
+
+class TestRlp:
+    # Classic RLP reference vectors (yellow-paper / ethereum wiki examples).
+    VECTORS = [
+        (b"dog", bytes([0x83]) + b"dog"),
+        ([b"cat", b"dog"], bytes([0xC8, 0x83]) + b"cat" + bytes([0x83]) + b"dog"),
+        (b"", bytes([0x80])),
+        ([], bytes([0xC0])),
+        (b"\x0f", bytes([0x0F])),
+        (b"\x04\x00", bytes([0x82, 0x04, 0x00])),
+        (
+            [[], [[]], [[], [[]]]],
+            bytes([0xC7, 0xC0, 0xC1, 0xC0, 0xC3, 0xC0, 0xC1, 0xC0]),
+        ),
+        (
+            b"Lorem ipsum dolor sit amet, consectetur adipisicing elit",
+            bytes([0xB8, 0x38]) + b"Lorem ipsum dolor sit amet, consectetur adipisicing elit",
+        ),
+    ]
+
+    def test_vectors(self):
+        for item, expected in self.VECTORS:
+            assert rlp.encode(item) == expected
+            assert rlp.decode(expected) == item
+
+    def test_int_encoding(self):
+        assert rlp.encode(0) == bytes([0x80])
+        assert rlp.encode(15) == bytes([0x0F])
+        assert rlp.encode(1024) == bytes([0x82, 0x04, 0x00])
+
+    def test_long_list_roundtrip(self):
+        item = [b"x" * 100, [b"y" * 300, b"z"], b""]
+        assert rlp.decode(rlp.encode(item)) == item
+
+    def test_reject_trailing(self):
+        with pytest.raises(rlp.RlpError):
+            rlp.decode(rlp.encode(b"dog") + b"\x00")
+
+    def test_reject_noncanonical(self):
+        with pytest.raises(rlp.RlpError):
+            rlp.decode(bytes([0x81, 0x05]))  # single byte < 0x80 must be literal
+
+
+class TestSm3:
+    def test_abc(self):
+        # GB/T 32905-2016 appendix A.1 example vector.
+        assert (
+            sm3.sm3_hash(b"abc").hex()
+            == "66c7f0f462eeedd9d1f2d46bdc10e4e24167c4875cf2f7a2297da02b8f4ba8e0"
+        )
+
+    def test_abcd_x16(self):
+        # GB/T 32905-2016 appendix A.2 example vector (512-bit message).
+        assert (
+            sm3.sm3_hash(b"abcd" * 16).hex()
+            == "debe9ff92275b8a138604889c18e5a4d6fdb70e5387e5765293dcba39c0c5732"
+        )
+
+    def test_empty(self):
+        assert (
+            sm3.sm3_hash(b"").hex()
+            == "1ab21d8355cfa17f8e61194831e81a8f22bec8c728fefb747ed035eb5082aa2b"
+        )
+
+    def test_width(self):
+        assert len(sm3.sm3_hash(b"anything")) == sm3.HASH_BYTES_LEN == 32
+
+
+def _sample_agg_vote(height=7, round_=2):
+    return AggregatedVote(
+        signature=AggregatedSignature(signature=b"\x01" * 96, address_bitmap=b"\xE0"),
+        vote_type=VoteType.PRECOMMIT,
+        height=height,
+        round=round_,
+        block_hash=b"\xAB" * 32,
+        leader=b"\x11" * 48,
+    )
+
+
+class TestWireTypes:
+    def test_vote_roundtrip(self):
+        v = Vote(5, 1, VoteType.PREVOTE, b"\x22" * 32)
+        assert Vote.from_rlp(rlp.decode(v.encode())) == v
+
+    def test_signed_vote_roundtrip(self):
+        sv = SignedVote(b"\x33" * 48, b"\x44" * 96, Vote(9, 0, VoteType.PRECOMMIT, b"\x55" * 32))
+        assert SignedVote.decode(sv.encode()) == sv
+
+    def test_aggregated_vote_roundtrip(self):
+        av = _sample_agg_vote()
+        assert AggregatedVote.decode(av.encode()) == av
+        assert av.to_vote() == Vote(7, 2, VoteType.PRECOMMIT, b"\xAB" * 32)
+
+    def test_proposal_roundtrip_with_and_without_lock(self):
+        for lock in (None, _sample_agg_vote()):
+            p = Proposal(3, 1, b"block-bytes", b"\x77" * 32, lock, b"\x88" * 48)
+            sp = SignedProposal(p, b"\x99" * 96)
+            assert SignedProposal.decode(sp.encode()) == sp
+
+    def test_choke_roundtrip(self):
+        sc = SignedChoke(b"\xAA" * 96, b"\xBB" * 48, Choke(11, 4))
+        assert SignedChoke.decode(sc.encode()) == sc
+
+    def test_proof_roundtrip(self):
+        pf = Proof(100, 0, b"\xCC" * 32,
+                   AggregatedSignature(b"\xDD" * 96, b"\xF0"))
+        assert Proof.decode(pf.encode()) == pf
+
+    def test_duration_config_defaults(self):
+        # Reference src/util.rs:90: DurationConfig::new(15, 10, 10, 7).
+        dc = DurationConfig()
+        assert (dc.propose_ratio, dc.prevote_ratio, dc.precommit_ratio,
+                dc.brake_ratio) == (15, 10, 10, 7)
+        assert DurationConfig.from_rlp(
+            [rlp.encode_int(x) for x in (15, 10, 10, 7)]) == dc
+
+    def test_validator_helpers(self):
+        vals = [b"\x01" * 48, b"\x02" * 48]
+        nodes = validators_to_nodes(vals)
+        assert all(n.propose_weight == 1 and n.vote_weight == 1 for n in nodes)
+        # Reference src/util.rs:93-97: origin = BE u64 of first 8 bytes.
+        assert validator_to_origin(b"\x00" * 7 + b"\x2A" + b"\xFF" * 40) == 42
+
+
+class TestBitmap:
+    def test_roundtrip(self):
+        nodes = [Node(bytes([i]) * 48) for i in (5, 1, 9, 3, 7, 2, 8, 6, 4)]
+        voters = [nodes[0].address, nodes[2].address, nodes[8].address]
+        bm = bitmap.build_bitmap(nodes, voters)
+        assert len(bm) == 2  # 9 authorities -> 2 bytes
+        extracted = bitmap.extract_voters(nodes, bm)
+        assert sorted(extracted) == sorted(voters)
+        # Extraction order is sorted-authority order.
+        assert extracted == sorted(extracted)
+
+    def test_unknown_voter_rejected(self):
+        nodes = [Node(b"\x01" * 48)]
+        with pytest.raises(ValueError):
+            bitmap.build_bitmap(nodes, [b"\x02" * 48])
+
+    def test_wrong_length_rejected(self):
+        nodes = [Node(b"\x01" * 48)]
+        with pytest.raises(ValueError):
+            bitmap.extract_voters(nodes, b"\x00\x00")
+
+    def test_padding_bits_rejected(self):
+        # Set bits beyond the authority count would make proof bytes malleable.
+        nodes = [Node(bytes([i]) * 48) for i in range(9)]
+        with pytest.raises(ValueError):
+            bitmap.extract_voters(nodes, b"\x80\x7f")
+
+    def test_lock_byte_string_form_rejected(self):
+        # An absent proposal lock must be exactly the empty list.
+        p = Proposal(1, 0, b"c", b"\xaa" * 32, None, b"\xbb" * 48)
+        item = rlp.decode(p.encode())
+        item[4] = b""
+        with pytest.raises(rlp.RlpError):
+            Proposal.from_rlp(item)
