@@ -31,6 +31,8 @@ def encode_int(value: int) -> bytes:
 
 
 def decode_int(data: bytes) -> int:
+    if not isinstance(data, (bytes, bytearray)):
+        raise RlpError(f"RLP integer must be bytes, got {type(data).__name__}")
     if data[:1] == b"\x00":
         raise RlpError("leading zero in RLP integer")
     return int.from_bytes(data, "big")
@@ -58,7 +60,14 @@ def encode(item: RlpItem) -> bytes:
     raise RlpError(f"cannot RLP-encode {type(item).__name__}")
 
 
-def _decode_at(data: bytes, pos: int) -> tuple[RlpItem, int]:
+# Nesting bound: deeper input is adversarial (a few-KB message could
+# otherwise force RecursionError, escaping the callers' RlpError contract).
+MAX_DEPTH = 64
+
+
+def _decode_at(data: bytes, pos: int, depth: int = 0) -> tuple[RlpItem, int]:
+    if depth > MAX_DEPTH:
+        raise RlpError("RLP nesting too deep")
     if pos >= len(data):
         raise RlpError("truncated RLP")
     prefix = data[pos]
@@ -88,7 +97,7 @@ def _decode_at(data: bytes, pos: int) -> tuple[RlpItem, int]:
         end = pos + 1 + length
         if end > len(data):
             raise RlpError("truncated RLP list")
-        return _decode_list(data, pos + 1, end), end
+        return _decode_list(data, pos + 1, end, depth), end
     # long list
     len_of_len = prefix - 0xF7
     length = decode_int(data[pos + 1 : pos + 1 + len_of_len])
@@ -98,14 +107,14 @@ def _decode_at(data: bytes, pos: int) -> tuple[RlpItem, int]:
     end = start + length
     if end > len(data):
         raise RlpError("truncated RLP list")
-    return _decode_list(data, start, end), end
+    return _decode_list(data, start, end, depth), end
 
 
-def _decode_list(data: bytes, start: int, end: int) -> List[RlpItem]:
+def _decode_list(data: bytes, start: int, end: int, depth: int) -> List[RlpItem]:
     items: List[RlpItem] = []
     pos = start
     while pos < end:
-        item, pos = _decode_at(data, pos)
+        item, pos = _decode_at(data, pos, depth + 1)
         items.append(item)
     if pos != end:
         raise RlpError("list payload overrun")

@@ -24,6 +24,36 @@ Address = bytes  # validator identity = serialized public key bytes
 Hash = bytes     # 32-byte SM3 digest
 
 
+# Strict decode helpers: every field position must carry the expected RLP
+# kind and every struct the exact arity, or byte-distinct encodings of equal
+# objects become possible (malleability of signed/hashed bytes).
+
+def _arity(item, n: int) -> list:
+    if not isinstance(item, list):
+        raise rlp.RlpError("expected RLP list")
+    if len(item) != n:
+        raise rlp.RlpError(f"expected {n}-element RLP list, got {len(item)}")
+    return item
+
+
+def _bytes_field(item) -> bytes:
+    if not isinstance(item, (bytes, bytearray)):
+        raise rlp.RlpError("expected RLP byte string")
+    return bytes(item)
+
+
+def _int_field(item) -> int:
+    return rlp.decode_int(_bytes_field(item))
+
+
+def _vote_type_field(item) -> "VoteType":
+    value = _int_field(item)
+    try:
+        return VoteType(value)
+    except ValueError as e:
+        raise rlp.RlpError(f"invalid vote type {value}") from e
+
+
 class VoteType(enum.IntEnum):
     """Phase of a vote (reference: overlord VoteType, used src/consensus.rs:171)."""
 
@@ -57,7 +87,9 @@ class Node:
 
     @classmethod
     def from_rlp(cls, item: list) -> "Node":
-        return cls(bytes(item[0]), rlp.decode_int(item[1]), rlp.decode_int(item[2]))
+        item = _arity(item, 3)
+        return cls(_bytes_field(item[0]), _int_field(item[1]),
+                   _int_field(item[2]))
 
 
 @dataclass(frozen=True)
@@ -77,7 +109,8 @@ class DurationConfig:
 
     @classmethod
     def from_rlp(cls, item: list) -> "DurationConfig":
-        return cls(*(rlp.decode_int(x) for x in item))
+        item = _arity(item, 4)
+        return cls(*(_int_field(x) for x in item))
 
 
 @dataclass(frozen=True)
@@ -96,8 +129,9 @@ class Vote:
 
     @classmethod
     def from_rlp(cls, item: list) -> "Vote":
-        return cls(rlp.decode_int(item[0]), rlp.decode_int(item[1]),
-                   VoteType(rlp.decode_int(item[2])), bytes(item[3]))
+        item = _arity(item, 4)
+        return cls(_int_field(item[0]), _int_field(item[1]),
+                   _vote_type_field(item[2]), _bytes_field(item[3]))
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())
@@ -117,7 +151,9 @@ class SignedVote:
 
     @classmethod
     def from_rlp(cls, item: list) -> "SignedVote":
-        return cls(bytes(item[0]), bytes(item[1]), Vote.from_rlp(item[2]))
+        item = _arity(item, 3)
+        return cls(_bytes_field(item[0]), _bytes_field(item[1]),
+                   Vote.from_rlp(item[2]))
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())
@@ -141,7 +177,8 @@ class AggregatedSignature:
 
     @classmethod
     def from_rlp(cls, item: list) -> "AggregatedSignature":
-        return cls(bytes(item[0]), bytes(item[1]))
+        item = _arity(item, 2)
+        return cls(_bytes_field(item[0]), _bytes_field(item[1]))
 
 
 @dataclass(frozen=True)
@@ -163,9 +200,11 @@ class AggregatedVote:
 
     @classmethod
     def from_rlp(cls, item: list) -> "AggregatedVote":
+        item = _arity(item, 6)
         return cls(AggregatedSignature.from_rlp(item[0]),
-                   VoteType(rlp.decode_int(item[1])), rlp.decode_int(item[2]),
-                   rlp.decode_int(item[3]), bytes(item[4]), bytes(item[5]))
+                   _vote_type_field(item[1]), _int_field(item[2]),
+                   _int_field(item[3]), _bytes_field(item[4]),
+                   _bytes_field(item[5]))
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())
@@ -200,13 +239,15 @@ class Proposal:
 
     @classmethod
     def from_rlp(cls, item: list) -> "Proposal":
+        item = _arity(item, 6)
         if not isinstance(item[4], list) or len(item[4]) > 1:
             # An absent lock is exactly the empty list (0xc0); accepting the
             # empty byte string too would make signed proposal bytes malleable.
             raise rlp.RlpError("proposal lock must be a 0/1-element list")
         lock = AggregatedVote.from_rlp(item[4][0]) if item[4] else None
-        return cls(rlp.decode_int(item[0]), rlp.decode_int(item[1]),
-                   bytes(item[2]), bytes(item[3]), lock, bytes(item[5]))
+        return cls(_int_field(item[0]), _int_field(item[1]),
+                   _bytes_field(item[2]), _bytes_field(item[3]), lock,
+                   _bytes_field(item[5]))
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())
@@ -225,7 +266,8 @@ class SignedProposal:
 
     @classmethod
     def from_rlp(cls, item: list) -> "SignedProposal":
-        return cls(Proposal.from_rlp(item[0]), bytes(item[1]))
+        item = _arity(item, 2)
+        return cls(Proposal.from_rlp(item[0]), _bytes_field(item[1]))
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())
@@ -248,7 +290,8 @@ class Choke:
 
     @classmethod
     def from_rlp(cls, item: list) -> "Choke":
-        return cls(rlp.decode_int(item[0]), rlp.decode_int(item[1]))
+        item = _arity(item, 2)
+        return cls(_int_field(item[0]), _int_field(item[1]))
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())
@@ -265,7 +308,9 @@ class SignedChoke:
 
     @classmethod
     def from_rlp(cls, item: list) -> "SignedChoke":
-        return cls(bytes(item[0]), bytes(item[1]), Choke.from_rlp(item[2]))
+        item = _arity(item, 3)
+        return cls(_bytes_field(item[0]), _bytes_field(item[1]),
+                   Choke.from_rlp(item[2]))
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())
@@ -294,8 +339,10 @@ class Proof:
 
     @classmethod
     def from_rlp(cls, item: list) -> "Proof":
-        return cls(rlp.decode_int(item[0]), rlp.decode_int(item[1]),
-                   bytes(item[2]), AggregatedSignature.from_rlp(item[3]))
+        item = _arity(item, 4)
+        return cls(_int_field(item[0]), _int_field(item[1]),
+                   _bytes_field(item[2]),
+                   AggregatedSignature.from_rlp(item[3]))
 
     def encode(self) -> bytes:
         return rlp.encode(self.to_rlp())

@@ -1,0 +1,10 @@
+"""Crypto port and backends: CPU oracle (BLS12-381, Ed25519) and TPU-batched
+providers (limb-field arithmetic under jit, Pallas kernels)."""
+
+from .provider import (  # noqa: F401
+    CpuBlsCrypto,
+    CryptoError,
+    CryptoProvider,
+    Ed25519Crypto,
+    load_private_key,
+)

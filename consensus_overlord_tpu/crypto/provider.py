@@ -1,0 +1,169 @@
+"""The Crypto port: the exact provider surface the reference's engine
+consumes (Overlord `Crypto` trait, reference src/consensus.rs:385-463):
+
+    hash(bytes) -> 32B        — SM3 (src/consensus.rs:386-388)
+    sign(hash) -> sig         — sign the 32-byte hash (389-395)
+    verify_signature(sig, hash, voter) -> bool        (397-416)
+    aggregate_signatures(sigs, voters) -> agg_sig     (418-444, length-checked)
+    verify_aggregated_signature(agg_sig, hash, voters) -> bool  (446-462)
+
+`voter` bytes ARE the public key (src/consensus.rs:406).  Implementations are
+interchangeable: `CpuBlsCrypto` is the reference-faithful BLS12-381 oracle,
+`Ed25519Crypto` is a fast host-CPU scheme for large simulations (BASELINE.md
+config 2's curve), and the TPU-batched providers live in crypto/tpu_*.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Protocol, Sequence, runtime_checkable
+
+from ..core.sm3 import sm3_hash
+from . import bls12381 as bls
+
+
+class CryptoError(Exception):
+    """Crypto failure (reference error.rs:20-44 `ConsensusError::CryptoErr`)."""
+
+
+@runtime_checkable
+class CryptoProvider(Protocol):
+    """What the engine needs from a crypto backend."""
+
+    @property
+    def pub_key(self) -> bytes:
+        """This node's identity: serialized public key bytes, doubling as its
+        validator address (reference src/consensus.rs:352-357)."""
+        ...
+
+    def hash(self, data: bytes) -> bytes: ...
+
+    def sign(self, hash32: bytes) -> bytes: ...
+
+    def verify_signature(self, signature: bytes, hash32: bytes,
+                         voter: bytes) -> bool: ...
+
+    def aggregate_signatures(self, signatures: Sequence[bytes],
+                             voters: Sequence[bytes]) -> bytes: ...
+
+    def verify_aggregated_signature(self, agg_sig: bytes, hash32: bytes,
+                                    voters: Sequence[bytes]) -> bool: ...
+
+
+def load_private_key(path: str) -> int:
+    """Read a hex-encoded 32-byte scalar (reference src/consensus.rs:348-350,
+    example/private_key)."""
+    with open(path, "r", encoding="utf-8") as f:
+        hex_str = f.read().strip()
+    if hex_str.startswith("0x"):
+        hex_str = hex_str[2:]
+    return int(hex_str, 16)
+
+
+class CpuBlsCrypto:
+    """Reference-faithful BLS12-381 min-sig provider (CPU oracle).
+
+    `common_ref` is the signing domain string — "" in the reference
+    (src/consensus.rs:351)."""
+
+    def __init__(self, private_key: int, common_ref: bytes = b""):
+        self._sk = private_key % bls.R
+        if self._sk == 0:
+            raise CryptoError("private key is zero mod r")
+        self._common_ref = common_ref
+        self._pk = bls.sk_to_pk(self._sk)
+
+    @classmethod
+    def from_file(cls, path: str, common_ref: bytes = b"") -> "CpuBlsCrypto":
+        return cls(load_private_key(path), common_ref)
+
+    @property
+    def pub_key(self) -> bytes:
+        return self._pk
+
+    def hash(self, data: bytes) -> bytes:
+        return sm3_hash(data)
+
+    def sign(self, hash32: bytes) -> bytes:
+        return bls.sign(self._sk, hash32, self._common_ref)
+
+    def verify_signature(self, signature: bytes, hash32: bytes,
+                         voter: bytes) -> bool:
+        return bls.verify(voter, hash32, signature, self._common_ref)
+
+    def aggregate_signatures(self, signatures: Sequence[bytes],
+                             voters: Sequence[bytes]) -> bytes:
+        # Length check mirrors reference src/consensus.rs:424-429.
+        if len(signatures) != len(voters):
+            raise CryptoError(
+                f"signatures x voters length mismatch "
+                f"{len(signatures)} x {len(voters)}")
+        try:
+            return bls.aggregate_signatures(signatures)
+        except ValueError as e:
+            raise CryptoError(str(e)) from e
+
+    def verify_aggregated_signature(self, agg_sig: bytes, hash32: bytes,
+                                    voters: Sequence[bytes]) -> bool:
+        return bls.aggregate_verify_same_message(
+            voters, hash32, agg_sig, self._common_ref)
+
+
+class Ed25519Crypto:
+    """Fast host-CPU provider for large simulations (Ed25519 via the
+    `cryptography` package).  Aggregation is concatenation + per-signature
+    verification — crypto-agility for fleets where pairing cost would mask
+    the engine behavior under test.  Addresses are 32-byte Ed25519 pubkeys."""
+
+    SIG_LEN = 64
+
+    def __init__(self, seed32: bytes):
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+
+        self._ed25519 = ed25519
+        self._sk = ed25519.Ed25519PrivateKey.from_private_bytes(seed32)
+        from cryptography.hazmat.primitives import serialization
+
+        self._pk = self._sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+
+    @property
+    def pub_key(self) -> bytes:
+        return self._pk
+
+    def hash(self, data: bytes) -> bytes:
+        return sm3_hash(data)
+
+    def sign(self, hash32: bytes) -> bytes:
+        return self._sk.sign(hash32)
+
+    def verify_signature(self, signature: bytes, hash32: bytes,
+                         voter: bytes) -> bool:
+        try:
+            pk = self._ed25519.Ed25519PublicKey.from_public_bytes(bytes(voter))
+            pk.verify(bytes(signature), bytes(hash32))
+            return True
+        except Exception:
+            return False
+
+    def aggregate_signatures(self, signatures: Sequence[bytes],
+                             voters: Sequence[bytes]) -> bytes:
+        if len(signatures) != len(voters):
+            raise CryptoError(
+                f"signatures x voters length mismatch "
+                f"{len(signatures)} x {len(voters)}")
+        for sig in signatures:
+            if len(sig) != self.SIG_LEN:
+                raise CryptoError("bad ed25519 signature length")
+        return b"".join(signatures)
+
+    def verify_aggregated_signature(self, agg_sig: bytes, hash32: bytes,
+                                    voters: Sequence[bytes]) -> bool:
+        if not voters:  # match CpuBlsCrypto: an empty QC never verifies
+            return False
+        if len(agg_sig) != self.SIG_LEN * len(voters):
+            return False
+        return all(
+            self.verify_signature(
+                agg_sig[i * self.SIG_LEN:(i + 1) * self.SIG_LEN], hash32, v)
+            for i, v in enumerate(voters))

@@ -1,0 +1,176 @@
+"""BLS12-381 CPU oracle tests: field tower, pairing laws, serialization,
+signature scheme, and the provider port."""
+
+import pytest
+
+from consensus_overlord_tpu.crypto import bls12381 as bls
+from consensus_overlord_tpu.crypto.provider import (
+    CpuBlsCrypto,
+    CryptoError,
+    Ed25519Crypto,
+)
+
+SK1 = 0x263DDE57AE9E9F9E285C96F1DD21BC9B9E91B321ADF6B8A0F8B07ACDA9D8C2B1 % bls.R
+SK2 = 0x0A0B0C0D0E0F101112131415161718191A1B1C1D1E1F2021222324252627282A % bls.R
+
+
+class TestFieldTower:
+    def test_fq2_inverse(self):
+        a = (123456789, 987654321)
+        assert bls.fq2_mul(a, bls.fq2_inv(a)) == bls.FQ2_ONE
+
+    def test_fq6_inverse(self):
+        a = ((1, 2), (3, 4), (5, 6))
+        assert bls.fq6_mul(a, bls.fq6_inv(a)) == bls.FQ6_ONE
+
+    def test_fq12_inverse_and_pow(self):
+        a = bls.fq12_add(bls.fq2_to_fq12((7, 9)), (bls.FQ6_ZERO, bls.FQ6_ONE))
+        assert bls.fq12_mul(a, bls.fq12_inv(a)) == bls.FQ12_ONE
+        assert bls.fq12_pow(a, 5) == bls.fq12_mul(
+            bls.fq12_mul(bls.fq12_mul(a, a), bls.fq12_mul(a, a)), a)
+
+    def test_fq2_sqrt_roundtrip(self):
+        a = (31415926, 27182818)
+        sq = bls.fq2_sq(a)
+        root = bls.fq2_sqrt(sq)
+        assert root in (a, bls.fq2_neg(a))
+
+
+class TestCurve:
+    def test_generators_on_curve_and_in_subgroup(self):
+        assert bls.g1_in_subgroup(bls.G1_GEN)
+        assert bls.g2_in_subgroup(bls.G2_GEN)
+
+    def test_group_law(self):
+        p2 = bls.g1_mul(bls.G1_GEN, 2)
+        p3 = bls.g1_mul(bls.G1_GEN, 3)
+        assert bls.g1_add(p2, bls.G1_GEN) == p3
+        assert bls.g1_add(p3, bls.g1_neg(p3)) is None
+        q2 = bls.g2_mul(bls.G2_GEN, 2)
+        assert bls.g2_add(q2, bls.G2_GEN) == bls.g2_mul(bls.G2_GEN, 3)
+
+    def test_scalar_mul_order(self):
+        assert bls.g1_mul(bls.G1_GEN, bls.R) is None
+        assert bls.g2_mul(bls.G2_GEN, bls.R) is None
+
+
+class TestSerialization:
+    def test_g1_generator_known_answer(self):
+        # Standard compressed G1 generator (ZCash format).
+        assert bls.g1_compress(bls.G1_GEN).hex() == (
+            "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+            "6c55e83ff97a1aeffb3af00adb22c6bb")
+
+    def test_g2_generator_known_answer(self):
+        # Standard compressed G2 generator (ZCash format).
+        assert bls.g2_compress(bls.G2_GEN).hex() == (
+            "93e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+            "334cf11213945d57e5ac7d055d042b7e024aa2b2f08f0a91260805272dc51051"
+            "c6e47ad4fa403b02b4510b647ae3d1770bac0326a805bbefd48056c8c121bdb8")
+
+    def test_roundtrip_including_sign_bit(self):
+        for k in (1, 2, 5, 1234567):
+            p = bls.g1_mul(bls.G1_GEN, k)
+            assert bls.g1_decompress(bls.g1_compress(p)) == p
+            q = bls.g2_mul(bls.G2_GEN, k)
+            assert bls.g2_decompress(bls.g2_compress(q)) == q
+
+    def test_infinity_roundtrip(self):
+        assert bls.g1_decompress(bls.g1_compress(None)) is None
+        assert bls.g2_decompress(bls.g2_compress(None)) is None
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            bls.g1_decompress(b"\x00" * 48)  # not compressed
+        with pytest.raises(ValueError):
+            bls.g1_decompress(bytes([0x80]) + b"\xff" * 47)  # x >= p
+        with pytest.raises(ValueError):
+            bls.g1_decompress(b"\x97" * 10)  # wrong length
+        # x not on curve: search the first x where x³+4 is a non-residue.
+        x = next(x for x in range(1, 100)
+                 if bls.fq_sqrt((x * x * x + 4) % bls.P) is None)
+        bad = bytearray(x.to_bytes(48, "big"))
+        bad[0] |= 0x80
+        with pytest.raises(ValueError):
+            bls.g1_decompress(bytes(bad))
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        e = bls.pairing(bls.G2_GEN, bls.G1_GEN)
+        assert e != bls.FQ12_ONE
+        assert bls.pairing(bls.G2_GEN, bls.g1_mul(bls.G1_GEN, 2)) == \
+            bls.fq12_pow(e, 2)
+        assert bls.pairing(bls.g2_mul(bls.G2_GEN, 3), bls.G1_GEN) == \
+            bls.fq12_pow(e, 3)
+
+    def test_multi_pairing_cancellation(self):
+        # e(P, -Q) * e(P, Q) == 1
+        neg_g2 = (bls.G2_GEN[0], bls.fq2_neg(bls.G2_GEN[1]))
+        assert bls.multi_pairing_is_one(
+            [(bls.G1_GEN, bls.G2_GEN), (bls.G1_GEN, neg_g2)])
+
+
+class TestSignatureScheme:
+    def test_sign_verify(self):
+        pk = bls.sk_to_pk(SK1)
+        msg = b"\xaa" * 32
+        sig = bls.sign(SK1, msg)
+        assert len(sig) == 48 and len(pk) == 96
+        assert bls.verify(pk, msg, sig)
+        assert not bls.verify(pk, b"\xbb" * 32, sig)
+        assert not bls.verify(bls.sk_to_pk(SK2), msg, sig)
+
+    def test_deterministic(self):
+        assert bls.sign(SK1, b"m") == bls.sign(SK1, b"m")
+
+    def test_domain_separation(self):
+        pk = bls.sk_to_pk(SK1)
+        sig = bls.sign(SK1, b"m", domain=b"chain-a")
+        assert bls.verify(pk, b"m", sig, domain=b"chain-a")
+        assert not bls.verify(pk, b"m", sig, domain=b"chain-b")
+
+    def test_aggregate_verify(self):
+        sks = [SK1, SK2, (SK1 * 7 + 3) % bls.R]
+        pks = [bls.sk_to_pk(s) for s in sks]
+        msg = b"\xcd" * 32
+        agg = bls.aggregate_signatures([bls.sign(s, msg) for s in sks])
+        assert len(agg) == 48
+        assert bls.aggregate_verify_same_message(pks, msg, agg)
+        assert not bls.aggregate_verify_same_message(pks[:2], msg, agg)
+        assert not bls.aggregate_verify_same_message(pks, b"\xce" * 32, agg)
+
+    def test_garbage_signature_rejected_not_raised(self):
+        pk = bls.sk_to_pk(SK1)
+        assert not bls.verify(pk, b"m", b"\x00" * 48)
+        assert not bls.verify(b"\x01" * 96, b"m", bls.sign(SK1, b"m"))
+
+
+class TestProviders:
+    def test_cpu_bls_provider_roundtrip(self):
+        a = CpuBlsCrypto(SK1)
+        b = CpuBlsCrypto(SK2)
+        h = a.hash(b"proposal data")
+        assert len(h) == 32
+        sig_a, sig_b = a.sign(h), b.sign(h)
+        assert b.verify_signature(sig_a, h, a.pub_key)
+        assert not b.verify_signature(sig_a, h, b.pub_key)
+        agg = a.aggregate_signatures([sig_a, sig_b], [a.pub_key, b.pub_key])
+        assert a.verify_aggregated_signature(agg, h, [a.pub_key, b.pub_key])
+        assert not a.verify_aggregated_signature(agg, h, [a.pub_key])
+
+    def test_aggregate_length_mismatch(self):
+        a = CpuBlsCrypto(SK1)
+        with pytest.raises(CryptoError):
+            a.aggregate_signatures([b"\x00" * 48], [])
+
+    def test_ed25519_provider(self):
+        a = Ed25519Crypto(b"\x01" * 32)
+        b = Ed25519Crypto(b"\x02" * 32)
+        h = a.hash(b"vote")
+        sig_a, sig_b = a.sign(h), b.sign(h)
+        assert b.verify_signature(sig_a, h, a.pub_key)
+        assert not a.verify_signature(sig_a, h, b.pub_key)
+        agg = a.aggregate_signatures([sig_a, sig_b], [a.pub_key, b.pub_key])
+        assert a.verify_aggregated_signature(agg, h, [a.pub_key, b.pub_key])
+        assert not a.verify_aggregated_signature(agg, h, [b.pub_key, a.pub_key])

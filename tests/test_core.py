@@ -62,27 +62,42 @@ class TestRlp:
         with pytest.raises(rlp.RlpError):
             rlp.decode(bytes([0x81, 0x05]))  # single byte < 0x80 must be literal
 
+    def test_deep_nesting_rejected(self):
+        with pytest.raises(rlp.RlpError):
+            rlp.decode(b"\xc1" * 5000 + b"\xc0")
+
 
 class TestSm3:
+    # Both the active sm3_hash (possibly OpenSSL-backed) and the from-scratch
+    # pure-Python fallback must match the standard vectors.
+    IMPLS = [sm3.sm3_hash, sm3._sm3_hash_py]
+
     def test_abc(self):
         # GB/T 32905-2016 appendix A.1 example vector.
-        assert (
-            sm3.sm3_hash(b"abc").hex()
-            == "66c7f0f462eeedd9d1f2d46bdc10e4e24167c4875cf2f7a2297da02b8f4ba8e0"
-        )
+        for impl in self.IMPLS:
+            assert (
+                impl(b"abc").hex()
+                == "66c7f0f462eeedd9d1f2d46bdc10e4e24167c4875cf2f7a2297da02b8f4ba8e0"
+            )
 
     def test_abcd_x16(self):
         # GB/T 32905-2016 appendix A.2 example vector (512-bit message).
-        assert (
-            sm3.sm3_hash(b"abcd" * 16).hex()
-            == "debe9ff92275b8a138604889c18e5a4d6fdb70e5387e5765293dcba39c0c5732"
-        )
+        for impl in self.IMPLS:
+            assert (
+                impl(b"abcd" * 16).hex()
+                == "debe9ff92275b8a138604889c18e5a4d6fdb70e5387e5765293dcba39c0c5732"
+            )
 
     def test_empty(self):
-        assert (
-            sm3.sm3_hash(b"").hex()
-            == "1ab21d8355cfa17f8e61194831e81a8f22bec8c728fefb747ed035eb5082aa2b"
-        )
+        for impl in self.IMPLS:
+            assert (
+                impl(b"").hex()
+                == "1ab21d8355cfa17f8e61194831e81a8f22bec8c728fefb747ed035eb5082aa2b"
+            )
+
+    def test_fallback_matches_active_on_long_input(self):
+        data = bytes(range(256)) * 33  # multi-block, unaligned tail
+        assert sm3.sm3_hash(data) == sm3._sm3_hash_py(data)
 
     def test_width(self):
         assert len(sm3.sm3_hash(b"anything")) == sm3.HASH_BYTES_LEN == 32
@@ -136,6 +151,38 @@ class TestWireTypes:
         assert DurationConfig.from_rlp(
             [rlp.encode_int(x) for x in (15, 10, 10, 7)]) == dc
 
+    def test_wrong_arity_rejected(self):
+        sv = SignedVote(b"\x01" * 48, b"\x02" * 96,
+                        Vote(1, 0, VoteType.PREVOTE, b"\x03" * 32))
+        item = rlp.decode(sv.encode())
+        item.append(b"extra")
+        with pytest.raises(rlp.RlpError):
+            SignedVote.from_rlp(item)
+
+    def test_wrong_field_kind_rejected(self):
+        # An RLP empty list where a byte string belongs must not decode to b"".
+        sv = SignedVote(b"", b"\x02" * 96,
+                        Vote(1, 0, VoteType.PREVOTE, b"\x03" * 32))
+        item = rlp.decode(sv.encode())
+        item[0] = []
+        with pytest.raises(rlp.RlpError):
+            SignedVote.from_rlp(item)
+
+    def test_invalid_vote_type_raises_rlp_error(self):
+        v = Vote(1, 0, VoteType.PREVOTE, b"\x03" * 32)
+        item = rlp.decode(v.encode())
+        item[2] = rlp.encode_int(9)
+        with pytest.raises(rlp.RlpError):
+            Vote.from_rlp(item)
+
+    def test_lock_byte_string_form_rejected(self):
+        # An absent proposal lock must be exactly the empty list.
+        p = Proposal(1, 0, b"c", b"\xaa" * 32, None, b"\xbb" * 48)
+        item = rlp.decode(p.encode())
+        item[4] = b""
+        with pytest.raises(rlp.RlpError):
+            Proposal.from_rlp(item)
+
     def test_validator_helpers(self):
         vals = [b"\x01" * 48, b"\x02" * 48]
         nodes = validators_to_nodes(vals)
@@ -171,10 +218,3 @@ class TestBitmap:
         with pytest.raises(ValueError):
             bitmap.extract_voters(nodes, b"\x80\x7f")
 
-    def test_lock_byte_string_form_rejected(self):
-        # An absent proposal lock must be exactly the empty list.
-        p = Proposal(1, 0, b"c", b"\xaa" * 32, None, b"\xbb" * 48)
-        item = rlp.decode(p.encode())
-        item[4] = b""
-        with pytest.raises(rlp.RlpError):
-            Proposal.from_rlp(item)
