@@ -400,6 +400,17 @@ WIRE_TYPES = {
 }
 
 
+def decode_wire_message(msg_type: str, payload: bytes):
+    """Decode an inbound consensus payload by its envelope type string — the
+    reference's proc_network_msg match (src/consensus.rs:210-262).  Raises
+    (RlpError or struct errors) on malformed input; callers log-and-drop
+    (src/consensus.rs:220-260: BFT tolerates lost messages)."""
+    cls = WIRE_TYPES.get(msg_type)
+    if cls is None:
+        raise rlp.RlpError(f"unknown consensus message type {msg_type!r}")
+    return cls.decode(payload)
+
+
 def validators_to_nodes(validators: Sequence[bytes]) -> List[Node]:
     """Reference src/util.rs:69-79: every validator gets weight 1."""
     return [Node(bytes(v), 1, 1) for v in validators]

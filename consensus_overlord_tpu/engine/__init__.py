@@ -1,0 +1,4 @@
+"""The Overlord-equivalent SMR engine and WAL."""
+
+from .smr import Engine, EngineHandler, Step, NIL_HASH, quorum_weight  # noqa: F401
+from .wal import FileWal, MemoryWal, OVERLORD_WAL_NAME  # noqa: F401

@@ -1,0 +1,809 @@
+"""The BFT SMR engine — the Overlord-equivalent consensus state machine.
+
+The reference delegates this entirely to the external `overlord` crate
+(reference Cargo.toml:9; instantiated src/consensus.rs:64-71, driven via
+OverlordHandler::send_msg and Overlord::run).  SURVEY.md §2.2 names it the
+largest single rebuild item.  This is a from-scratch implementation of the
+same protocol shape, reconstructed from the reference's use of the engine:
+
+  * height/round SMR with deterministic weighted-round-robin leader rotation
+  * SignedProposal broadcast by the round leader (src/consensus.rs:673-681)
+  * prevote / precommit phases; votes relayed point-to-point to the round
+    leader (transmit_to_relayer, src/consensus.rs:721-771), which aggregates
+    them into one BLS signature + voter bitmap and broadcasts an
+    AggregatedVote QC (src/consensus.rs:693-700)
+  * Tendermint-style lock/polka safety rules for proposals carrying a lock QC
+  * liveness via SignedChoke broadcast + brake timeouts -> view change
+    (src/consensus.rs:684-691, 777-779)
+  * WAL save/load at state transitions for crash recovery
+    (src/consensus.rs:314-332)
+  * runtime authority-set change via RichStatus injection and the Status
+    returned from commit (src/consensus.rs:114-121, 631-636)
+  * round timers scaled by DurationConfig ratios over the block interval
+    (src/util.rs:89-91: propose/prevote/precommit/brake = 15/10/10/7 tenths)
+
+Everything the engine needs from the outside world comes through the four
+ports (ConsensusAdapter, CryptoProvider, Wal, and the inbound mailbox) — the
+mailbox-injection + callback shape SURVEY.md §1 identifies as the key
+architectural pattern.
+
+Async design: one asyncio task owns all state; inbound messages, timer
+expiries, and completions of adapter calls (get_block / check_block / commit
+run as sub-tasks) all arrive through the same mailbox, so there is no shared
+mutable state and no locking.  The signature hot path is delegated to the
+crypto port, where the TPU-batched providers live.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..core import rlp
+from ..core.bitmap import build_bitmap, extract_voters, sorted_authorities
+from ..core.sm3 import sm3_hash
+from ..core.types import (
+    Address,
+    AggregatedSignature,
+    AggregatedVote,
+    Choke,
+    Commit,
+    DurationConfig,
+    Hash,
+    Node,
+    Proof,
+    Proposal,
+    SignedChoke,
+    SignedProposal,
+    SignedVote,
+    Status,
+    Vote,
+    VoteType,
+    MSG_TYPE_AGGREGATED_VOTE,
+    MSG_TYPE_SIGNED_CHOKE,
+    MSG_TYPE_SIGNED_PROPOSAL,
+    MSG_TYPE_SIGNED_VOTE,
+)
+from ..crypto.provider import CryptoProvider
+from ..ports import ConsensusAdapter, Wal
+
+logger = logging.getLogger("consensus_overlord_tpu.engine")
+
+#: Nil vote marker — voting "no block this round" (empty hash).
+NIL_HASH: Hash = b""
+
+
+class Step(enum.IntEnum):
+    PROPOSE = 0
+    PREVOTE = 1
+    PRECOMMIT = 2
+    BRAKE = 3
+
+
+def quorum_weight(total_weight: int) -> int:
+    """BFT quorum: > 2/3 of total weight."""
+    return total_weight * 2 // 3 + 1
+
+
+# ---------------------------------------------------------------------------
+# Mailbox messages (OverlordMsg equivalent)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _Timeout:
+    step: Step
+    height: int
+    round: int
+
+
+@dataclass(frozen=True)
+class _BlockChecked:
+    height: int
+    round: int
+    block_hash: Hash
+    ok: bool
+
+
+@dataclass(frozen=True)
+class _BlockFetched:
+    height: int
+    round: int
+    content: bytes
+    block_hash: Hash
+
+
+@dataclass(frozen=True)
+class _Committed:
+    height: int
+    status: Optional[Status]
+
+
+class _Stop:
+    pass
+
+
+class EngineHandler:
+    """The OverlordHandler equivalent (reference src/consensus.rs:71, 114,
+    216, 228, 240, 252): the only way the outside injects messages."""
+
+    def __init__(self, mailbox: "asyncio.Queue"):
+        self._mailbox = mailbox
+
+    def send_msg(self, msg) -> None:
+        """Accepts SignedProposal / SignedVote / AggregatedVote / SignedChoke
+        wire objects or a Status (RichStatus reconfiguration)."""
+        self._mailbox.put_nowait(msg)
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _WalState:
+    """Decoded WAL payload (applied by run() only when not stale)."""
+
+    height: int
+    round: int
+    my_prevote_round: Optional[int] = None
+    my_precommit_round: Optional[int] = None
+    lock_round: Optional[int] = None
+    lock_proposal: Optional[Proposal] = None
+    lock_qc: Optional[AggregatedVote] = None
+
+
+@dataclass
+class _VoteSet:
+    """Votes collected by the round leader, bucketed by block hash."""
+
+    by_hash: Dict[Hash, Dict[Address, bytes]] = field(default_factory=dict)
+    qc_sent: bool = False
+
+    def add(self, block_hash: Hash, voter: Address, sig: bytes) -> None:
+        self.by_hash.setdefault(block_hash, {})[voter] = sig
+
+
+class Engine:
+    """One validator's consensus engine instance.
+
+    name: this node's address (its serialized public key,
+    reference src/consensus.rs:352-357)."""
+
+    MAX_PENDING = 4096  # future-message buffer bound
+
+    def __init__(self, name: Address, adapter: ConsensusAdapter,
+                 crypto: CryptoProvider, wal: Wal):
+        self.name = bytes(name)
+        self.adapter = adapter
+        self.crypto = crypto
+        self.wal = wal
+        self._mailbox: asyncio.Queue = asyncio.Queue()
+        self.handler = EngineHandler(self._mailbox)
+
+        # Consensus state (owned exclusively by the run() task).
+        self.height = 0
+        self.round = 0
+        self.step = Step.PROPOSE
+        self.authorities: List[Node] = []
+        self.interval_ms = 3000
+        self.timer_config = DurationConfig()
+        self.lock_round: Optional[int] = None
+        self.lock_proposal: Optional[Proposal] = None
+        self.lock_qc: Optional[AggregatedVote] = None
+
+        # Per-height transient state.
+        self._contents: Dict[Hash, bytes] = {}
+        self._proposals: Dict[int, SignedProposal] = {}
+        self._prevotes: Dict[int, _VoteSet] = {}
+        self._precommits: Dict[int, _VoteSet] = {}
+        self._prevote_qcs: Dict[int, AggregatedVote] = {}
+        self._chokes: Dict[int, Dict[Address, bytes]] = {}
+        self._choke_rounds: Dict[Address, int] = {}  # highest choke round seen
+        self._my_prevote_round: Optional[int] = None
+        self._my_precommit_round: Optional[int] = None
+        self._committing = False
+
+        self._pending: List[object] = []  # future-height/round buffer
+        self._timers: Dict[Step, asyncio.TimerHandle] = {}
+        self._tasks: set = set()
+        self._running = False
+        #: wall-clock of the last commit, for block-interval pacing
+        self._last_commit_ts: float = 0.0
+
+    # -- public API --------------------------------------------------------
+
+    async def run(self, init_height: int, interval_ms: int,
+                  authority_list: List[Node],
+                  timer_config: Optional[DurationConfig] = None) -> None:
+        """Start the SMR loop (reference Overlord::run, src/consensus.rs:85-93).
+        Runs until stop() is called."""
+        self.interval_ms = max(int(interval_ms), 1)
+        if timer_config is not None:
+            self.timer_config = timer_config
+        self._set_authorities(authority_list)
+        self._running = True
+        start_height = init_height
+        start_round = 0
+        recovered = await self._load_wal()
+        self.height = start_height
+        self._reset_height_state()
+        if recovered is not None and recovered.height >= init_height:
+            # Apply the recovered state (incl. our own votes already cast this
+            # round — voting again after restart would be equivocation — and
+            # any lock) only when the recovery isn't stale.
+            start_height, start_round = recovered.height, recovered.round
+            self.height = start_height
+            self._my_prevote_round = recovered.my_prevote_round
+            self._my_precommit_round = recovered.my_precommit_round
+            self.lock_round = recovered.lock_round
+            self.lock_proposal = recovered.lock_proposal
+            self.lock_qc = recovered.lock_qc
+            if self.lock_proposal is not None:
+                self._contents[self.lock_proposal.block_hash] = \
+                    self.lock_proposal.content
+            logger.info("%s: WAL recovery to height=%d round=%d",
+                        self._tag(), start_height, start_round)
+        await self._enter_round(start_round)
+        try:
+            while self._running:
+                msg = await self._mailbox.get()
+                if isinstance(msg, _Stop):
+                    break
+                try:
+                    await self._dispatch(msg)
+                except Exception:  # noqa: BLE001 — BFT: log and drop
+                    logger.exception("%s: error handling %s", self._tag(),
+                                     type(msg).__name__)
+        finally:
+            self._running = False
+            self._cancel_timers()
+            for t in list(self._tasks):
+                t.cancel()
+
+    def stop(self) -> None:
+        self._running = False
+        self._mailbox.put_nowait(_Stop())
+
+    # -- internals ---------------------------------------------------------
+
+    def _tag(self) -> str:
+        return f"[{self.name[:4].hex()} h={self.height} r={self.round}]"
+
+    def _set_authorities(self, authority_list: List[Node]) -> None:
+        # Precompute the per-message lookups: votes arrive O(N) per round, so
+        # these must be O(1), not O(N) rebuilds (10k-validator fleets).
+        self.authorities = sorted_authorities(authority_list)
+        self._weight_map = {n.address: n.vote_weight for n in self.authorities}
+        self._total = sum(self._weight_map.values())
+        self._leader_slots: List[Address] = []
+        for n in self.authorities:
+            self._leader_slots.extend([n.address] * max(n.propose_weight, 1))
+
+    def _total_weight(self) -> int:
+        return self._total
+
+    def _weight_of(self, voters: List[Address]) -> int:
+        return sum(self._weight_map.get(v, 0) for v in voters)
+
+    def _is_validator(self, addr: Address) -> bool:
+        return addr in self._weight_map
+
+    def leader(self, height: int, round_: int) -> Address:
+        """Deterministic weighted-round-robin proposer: the (height + round)-th
+        slot in the propose-weight-expanded sorted authority list.  With the
+        reference's all-equal weights (src/util.rs:74-76) this is plain
+        round-robin."""
+        return self._leader_slots[(height + round_) % len(self._leader_slots)]
+
+    # -- WAL ---------------------------------------------------------------
+
+    async def _save_wal(self) -> None:
+        """Persist everything a restart must not forget: position, our own
+        votes this round (re-voting after a crash is equivocation), and the
+        lock.  Optional rounds encode as value+1 with 0 = None."""
+        lock_item: list = []
+        if (self.lock_round is not None and self.lock_proposal is not None
+                and self.lock_qc is not None):
+            lock_item = [self.lock_round, self.lock_proposal.to_rlp(),
+                         self.lock_qc.to_rlp()]
+        pv = 0 if self._my_prevote_round is None else self._my_prevote_round + 1
+        pc = (0 if self._my_precommit_round is None
+              else self._my_precommit_round + 1)
+        data = rlp.encode([self.height, self.round, pv, pc, lock_item])
+        await self.wal.save(data)
+
+    async def _load_wal(self) -> Optional["_WalState"]:
+        """Parse (never apply — run() decides) the persisted state."""
+        data = await self.wal.load()
+        if not data:
+            return None
+        try:
+            item = rlp.decode(data)
+            pv = rlp.decode_int(item[2])
+            pc = rlp.decode_int(item[3])
+            state = _WalState(
+                height=rlp.decode_int(item[0]),
+                round=rlp.decode_int(item[1]),
+                my_prevote_round=None if pv == 0 else pv - 1,
+                my_precommit_round=None if pc == 0 else pc - 1,
+            )
+            if item[4]:
+                state.lock_round = rlp.decode_int(item[4][0])
+                state.lock_proposal = Proposal.from_rlp(item[4][1])
+                state.lock_qc = AggregatedVote.from_rlp(item[4][2])
+            return state
+        except Exception:  # noqa: BLE001
+            logger.warning("%s: corrupt WAL ignored", self._tag())
+            return None
+
+    # -- height / round transitions ---------------------------------------
+
+    def _reset_height_state(self) -> None:
+        self._contents.clear()
+        self._proposals.clear()
+        self._prevotes.clear()
+        self._precommits.clear()
+        self._prevote_qcs.clear()
+        self._chokes.clear()
+        self._choke_rounds.clear()
+        self._my_prevote_round = None
+        self._my_precommit_round = None
+        self._committing = False
+        # Note: the lock (lock_round/lock_proposal/lock_qc) is deliberately
+        # NOT cleared here — it survives rounds and is cleared only on a
+        # height change (_enter_new_height) or stale-recovery reset (run()).
+
+    async def _enter_new_height(self, status: Status) -> None:
+        logger.info("%s: commit/status -> height %d", self._tag(), status.height)
+        self._last_commit_ts = asyncio.get_running_loop().time()
+        self.height = status.height
+        self.round = 0
+        if status.interval:
+            self.interval_ms = status.interval
+        if status.timer_config is not None:
+            self.timer_config = status.timer_config
+        if status.authority_list:
+            self._set_authorities(status.authority_list)
+        self.lock_round = None
+        self.lock_proposal = None
+        self.lock_qc = None
+        self._reset_height_state()
+        await self._enter_round(0)
+        self._drain_pending()
+
+    async def _enter_round(self, round_: int) -> None:
+        self.round = round_
+        self.step = Step.PROPOSE
+        self._cancel_timers()
+        await self._save_wal()
+        logger.debug("%s: enter round %d (leader=%s)", self._tag(), round_,
+                     self.leader(self.height, round_)[:4].hex())
+        if self.leader(self.height, round_) == self.name:
+            self._spawn(self._propose())
+        self._set_timer(Step.PROPOSE, self.timer_config.propose_ratio)
+        self._drain_pending()
+
+    # -- timers ------------------------------------------------------------
+
+    def _set_timer(self, step: Step, ratio: int) -> None:
+        delay = self.interval_ms * ratio / 10 / 1000.0
+        prev = self._timers.pop(step, None)
+        if prev is not None:
+            prev.cancel()
+        loop = asyncio.get_running_loop()
+        h, r = self.height, self.round
+        self._timers[step] = loop.call_later(
+            delay, lambda: self._mailbox.put_nowait(_Timeout(step, h, r)))
+
+    def _cancel_timers(self) -> None:
+        for t in self._timers.values():
+            t.cancel()
+        self._timers.clear()
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    # -- proposing ---------------------------------------------------------
+
+    async def _propose(self) -> None:
+        """Leader path: fetch (or re-propose locked) content, then broadcast."""
+        height, round_ = self.height, self.round
+        if round_ == 0 and self._last_commit_ts > 0:
+            # Pace block production by the configured interval (the engine's
+            # `interval` semantics, reference src/consensus.rs:110, 117, 633).
+            elapsed = asyncio.get_running_loop().time() - self._last_commit_ts
+            wait = self.interval_ms / 1000.0 - elapsed
+            if wait > 0:
+                await asyncio.sleep(wait)
+            if height != self.height or round_ != self.round:
+                return
+        if self.lock_proposal is not None:
+            self._mailbox.put_nowait(_BlockFetched(
+                height, round_, self.lock_proposal.content,
+                self.lock_proposal.block_hash))
+            return
+        try:
+            content, block_hash = await self.adapter.get_block(height)
+        except Exception:  # noqa: BLE001
+            logger.exception("%s: get_block failed", self._tag())
+            return
+        self._mailbox.put_nowait(_BlockFetched(height, round_, content,
+                                               block_hash))
+
+    async def _on_block_fetched(self, msg: _BlockFetched) -> None:
+        if msg.height != self.height or msg.round != self.round:
+            return
+        if self.step != Step.PROPOSE:
+            return
+        lock_qc = self.lock_qc if self.lock_round is not None else None
+        proposal = Proposal(
+            height=msg.height, round=msg.round, content=msg.content,
+            block_hash=msg.block_hash, lock=lock_qc, proposer=self.name)
+        sig = self.crypto.sign(sm3_hash(proposal.encode()))
+        sp = SignedProposal(proposal, sig)
+        self._contents[msg.block_hash] = msg.content
+        await self.adapter.broadcast_to_other(
+            MSG_TYPE_SIGNED_PROPOSAL, sp.encode())
+        await self._on_signed_proposal(sp)  # self-delivery
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def _dispatch(self, msg) -> None:
+        if isinstance(msg, Status):
+            await self._on_rich_status(msg)
+        elif isinstance(msg, SignedProposal):
+            await self._on_signed_proposal(msg)
+        elif isinstance(msg, SignedVote):
+            await self._on_signed_vote(msg)
+        elif isinstance(msg, AggregatedVote):
+            await self._on_aggregated_vote(msg)
+        elif isinstance(msg, SignedChoke):
+            await self._on_signed_choke(msg)
+        elif isinstance(msg, _Timeout):
+            await self._on_timeout(msg)
+        elif isinstance(msg, _BlockFetched):
+            await self._on_block_fetched(msg)
+        elif isinstance(msg, _BlockChecked):
+            await self._on_block_checked(msg)
+        elif isinstance(msg, _Committed):
+            await self._on_committed(msg)
+        else:
+            logger.warning("%s: unknown mailbox message %r", self._tag(), msg)
+
+    def _buffer_future(self, msg, height: int, round_: Optional[int]) -> bool:
+        """Buffer messages for the next height or a future round of the
+        current height; drop anything older or too far ahead."""
+        if height == self.height and (round_ is None or round_ <= self.round):
+            return False  # current — process now
+        if height in (self.height, self.height + 1) and \
+                len(self._pending) < self.MAX_PENDING:
+            self._pending.append(msg)
+        return True
+
+    def _drain_pending(self) -> None:
+        pending, self._pending = self._pending, []
+        for msg in pending:
+            self._mailbox.put_nowait(msg)
+
+    # -- reconfiguration (RichStatus) --------------------------------------
+
+    async def _on_rich_status(self, status: Status) -> None:
+        """Reference src/consensus.rs:114-121: controller-driven jump to a new
+        height (startup, reconfiguration, or resync after falling behind)."""
+        if status.height <= self.height and self.height != 0:
+            logger.debug("%s: stale RichStatus(%d) ignored", self._tag(),
+                         status.height)
+            return
+        await self._enter_new_height(status)
+
+    # -- proposal handling -------------------------------------------------
+
+    async def _on_signed_proposal(self, sp: SignedProposal) -> None:
+        p = sp.proposal
+        if p.height < self.height or p.height > self.height + 1:
+            return
+        if self._buffer_future(sp, p.height, p.round):
+            return
+        if p.round != self.round or p.round in self._proposals:
+            return
+        expected_leader = self.leader(p.height, p.round)
+        if p.proposer != expected_leader or not self._is_validator(p.proposer):
+            logger.warning("%s: proposal from non-leader", self._tag())
+            return
+        if not self.crypto.verify_signature(
+                sp.signature, sm3_hash(p.encode()), p.proposer):
+            logger.warning("%s: bad proposal signature", self._tag())
+            return
+        if p.lock is not None and not self._verify_lock_qc(p):
+            logger.warning("%s: bad lock QC on proposal", self._tag())
+            return
+        self._proposals[p.round] = sp
+        self._contents[p.block_hash] = p.content
+        # Lock rule (Tendermint safety): locked nodes prevote their lock
+        # unless the proposal carries a polka from a later round.
+        if self.lock_round is not None and self.lock_proposal is not None:
+            proposal_lock_round = p.lock.round if p.lock is not None else -1
+            if (p.block_hash != self.lock_proposal.block_hash
+                    and proposal_lock_round <= self.lock_round):
+                await self._cast_prevote(p.round, NIL_HASH)
+                return
+        # Validate content through the chain port, then prevote.
+        self._spawn(self._check_block(p.height, p.round, p.block_hash,
+                                      p.content))
+
+    def _verify_lock_qc(self, p: Proposal) -> bool:
+        qc = p.lock
+        if qc is None:
+            return True
+        if qc.height != p.height or qc.vote_type != VoteType.PREVOTE:
+            return False
+        if qc.round >= p.round or qc.block_hash != p.block_hash:
+            return False
+        return self._verify_qc(qc)
+
+    def _verify_qc(self, qc: AggregatedVote) -> bool:
+        """Aggregated-signature + quorum check for a QC (the reference's
+        check_block audit shape, src/consensus.rs:144-207)."""
+        try:
+            voters = extract_voters(self.authorities, qc.signature.address_bitmap)
+        except ValueError:
+            return False
+        if self._weight_of(voters) < quorum_weight(self._total_weight()):
+            return False
+        vote_hash = sm3_hash(qc.to_vote().encode())
+        return self.crypto.verify_aggregated_signature(
+            qc.signature.signature, vote_hash, voters)
+
+    async def _check_block(self, height: int, round_: int, block_hash: Hash,
+                           content: bytes) -> None:
+        if block_hash == NIL_HASH:
+            ok = False
+        else:
+            try:
+                ok = await self.adapter.check_block(height, block_hash, content)
+            except Exception:  # noqa: BLE001
+                logger.exception("%s: check_block failed", self._tag())
+                ok = False
+        self._mailbox.put_nowait(_BlockChecked(height, round_, block_hash, ok))
+
+    async def _on_block_checked(self, msg: _BlockChecked) -> None:
+        if msg.height != self.height or msg.round != self.round:
+            return
+        if self.step != Step.PROPOSE:
+            return
+        await self._cast_prevote(msg.round, msg.block_hash if msg.ok
+                                 else NIL_HASH)
+
+    # -- voting ------------------------------------------------------------
+
+    async def _cast_prevote(self, round_: int, block_hash: Hash) -> None:
+        if self._my_prevote_round == round_:
+            return
+        self._my_prevote_round = round_
+        self.step = Step.PREVOTE
+        self._set_timer(Step.PREVOTE, self.timer_config.prevote_ratio)
+        await self._save_wal()  # write-ahead: never re-vote after a crash
+        await self._send_vote(VoteType.PREVOTE, round_, block_hash)
+
+    async def _cast_precommit(self, round_: int, block_hash: Hash) -> None:
+        if self._my_precommit_round == round_:
+            return
+        self._my_precommit_round = round_
+        self.step = Step.PRECOMMIT
+        self._set_timer(Step.PRECOMMIT, self.timer_config.precommit_ratio)
+        await self._save_wal()  # write-ahead: never re-vote after a crash
+        await self._send_vote(VoteType.PRECOMMIT, round_, block_hash)
+
+    async def _send_vote(self, vote_type: VoteType, round_: int,
+                         block_hash: Hash) -> None:
+        vote = Vote(self.height, round_, vote_type, block_hash)
+        sig = self.crypto.sign(sm3_hash(vote.encode()))
+        sv = SignedVote(self.name, sig, vote)
+        relayer = self.leader(self.height, round_)
+        if relayer == self.name:
+            await self._on_signed_vote(sv)
+        else:
+            await self.adapter.transmit_to_relayer(
+                relayer, MSG_TYPE_SIGNED_VOTE, sv.encode())
+
+    async def _on_signed_vote(self, sv: SignedVote) -> None:
+        """Leader path: collect, verify, aggregate on quorum.  This per-vote
+        verify stream is the O(N) hot loop the TPU crypto batches
+        (reference src/consensus.rs:397-416; SURVEY.md §3.5)."""
+        v = sv.vote
+        if v.height < self.height or v.height > self.height + 1:
+            return
+        if self._buffer_future(sv, v.height, None):
+            return
+        if self.leader(v.height, v.round) != self.name:
+            return  # not the relayer for this round
+        if not self._is_validator(sv.voter):
+            return
+        vote_set = (self._prevotes if v.vote_type == VoteType.PREVOTE
+                    else self._precommits).setdefault(v.round, _VoteSet())
+        if vote_set.qc_sent:
+            return
+        if sv.voter in vote_set.by_hash.get(v.block_hash, {}):
+            return  # duplicate
+        if not self.crypto.verify_signature(
+                sv.signature, sm3_hash(v.encode()), sv.voter):
+            logger.warning("%s: bad vote signature from %s", self._tag(),
+                           sv.voter[:4].hex())
+            return
+        vote_set.add(v.block_hash, sv.voter, sv.signature)
+        await self._try_aggregate(v.vote_type, v.round, v.block_hash, vote_set)
+
+    async def _try_aggregate(self, vote_type: VoteType, round_: int,
+                             block_hash: Hash, vote_set: _VoteSet) -> None:
+        votes = vote_set.by_hash.get(block_hash, {})
+        if self._weight_of(list(votes)) < quorum_weight(self._total_weight()):
+            return
+        # Aggregate in sorted-voter order so the signature matches the
+        # bitmap extraction order at every verifier.
+        pairs = sorted(votes.items())
+        agg_sig = self.crypto.aggregate_signatures(
+            [sig for _, sig in pairs], [voter for voter, _ in pairs])
+        qc = AggregatedVote(
+            signature=AggregatedSignature(
+                agg_sig, build_bitmap(self.authorities, [v for v, _ in pairs])),
+            vote_type=vote_type, height=self.height, round=round_,
+            block_hash=block_hash, leader=self.name)
+        vote_set.qc_sent = True
+        await self.adapter.broadcast_to_other(
+            MSG_TYPE_AGGREGATED_VOTE, qc.encode())
+        await self._on_aggregated_vote(qc)  # self-delivery
+
+    # -- QC handling -------------------------------------------------------
+
+    async def _on_aggregated_vote(self, qc: AggregatedVote) -> None:
+        if qc.height < self.height or qc.height > self.height + 1:
+            return
+        if self._buffer_future(qc, qc.height, qc.round):
+            return
+        if qc.round != self.round:
+            # Precommit QCs from earlier rounds of this height still commit.
+            if not (qc.vote_type == VoteType.PRECOMMIT
+                    and qc.block_hash != NIL_HASH):
+                return
+        if not self._verify_qc(qc):
+            logger.warning("%s: bad QC", self._tag())
+            return
+        if qc.vote_type == VoteType.PREVOTE:
+            await self._on_prevote_qc(qc)
+        else:
+            await self._on_precommit_qc(qc)
+
+    async def _on_prevote_qc(self, qc: AggregatedVote) -> None:
+        if qc.round in self._prevote_qcs:
+            return
+        self._prevote_qcs[qc.round] = qc
+        if qc.block_hash != NIL_HASH:
+            # Polka: adopt the lock (newest polka wins).
+            if self.lock_round is None or qc.round > self.lock_round:
+                sp = self._proposals.get(qc.round)
+                content = self._contents.get(qc.block_hash)
+                if sp is not None and sp.proposal.block_hash == qc.block_hash:
+                    self.lock_round = qc.round
+                    self.lock_proposal = sp.proposal
+                    self.lock_qc = qc
+                    await self._save_wal()
+                elif content is not None:
+                    self.lock_round = qc.round
+                    self.lock_proposal = Proposal(
+                        qc.height, qc.round, content, qc.block_hash, None,
+                        self.leader(qc.height, qc.round))
+                    self.lock_qc = qc
+                    await self._save_wal()
+            await self._cast_precommit(qc.round, qc.block_hash)
+        else:
+            await self._cast_precommit(qc.round, NIL_HASH)
+
+    async def _on_precommit_qc(self, qc: AggregatedVote) -> None:
+        if qc.block_hash == NIL_HASH:
+            if qc.round == self.round:
+                await self._enter_round(self.round + 1)
+            return
+        if self._committing:
+            return
+        content = self._contents.get(qc.block_hash)
+        if content is None:
+            # We never saw the proposal; the controller resync path
+            # (ping_controller -> RichStatus) will pull us forward.
+            self.adapter.report_error(
+                f"precommit QC for unknown block at height {qc.height}")
+            return
+        self._committing = True
+        proof = Proof(qc.height, qc.round, qc.block_hash, qc.signature)
+        self._spawn(self._commit(qc.height, Commit(qc.height, content, proof)))
+
+    async def _commit(self, height: int, commit: Commit) -> None:
+        try:
+            status = await self.adapter.commit(height, commit)
+        except Exception:  # noqa: BLE001
+            logger.exception("%s: commit failed", self._tag())
+            self._mailbox.put_nowait(_Committed(height, None))
+            return
+        self._mailbox.put_nowait(_Committed(height, status))
+
+    async def _on_committed(self, msg: _Committed) -> None:
+        if msg.height != self.height:
+            return
+        if msg.status is None:
+            # Commit failed — allow retry on a future QC.
+            self._committing = False
+            return
+        await self._enter_new_height(msg.status)
+
+    # -- choke / view change ----------------------------------------------
+
+    async def _on_signed_choke(self, sc: SignedChoke) -> None:
+        c = sc.choke
+        if c.height != self.height:
+            return
+        if c.round < self.round:
+            return
+        if not self._is_validator(sc.address):
+            return
+        chokes = self._chokes.setdefault(c.round, {})
+        if sc.address in chokes:
+            return
+        if not self.crypto.verify_signature(
+                sc.signature, sm3_hash(c.encode()), sc.address):
+            logger.warning("%s: bad choke signature", self._tag())
+            return
+        chokes[sc.address] = sc.signature
+        self._choke_rounds[sc.address] = max(
+            self._choke_rounds.get(sc.address, -1), c.round)
+        if self._weight_of(list(chokes)) >= quorum_weight(self._total_weight()) \
+                and c.round >= self.round:
+            self.adapter.report_view_change(
+                self.height, self.round, "TIMEOUT_BRAKE quorum")
+            await self._enter_round(c.round + 1)
+            return
+        # Round skip (liveness after partition heal): if f+1 weight is choking
+        # in rounds above ours, the network has moved on — jump to the lowest
+        # such round and help choke it to quorum.
+        higher = sorted({r for r in self._choke_rounds.values()
+                         if r > self.round})
+        f_plus_1 = self._total_weight() // 3 + 1
+        for r in higher:
+            at_or_above = [v for v, cr in self._choke_rounds.items()
+                           if cr >= r]
+            if self._weight_of(at_or_above) >= f_plus_1:
+                self.adapter.report_view_change(
+                    self.height, self.round, f"round skip to {r}")
+                await self._enter_round(r)
+                break
+
+    async def _broadcast_choke(self) -> None:
+        choke = Choke(self.height, self.round)
+        sig = self.crypto.sign(sm3_hash(choke.encode()))
+        sc = SignedChoke(sig, self.name, choke)
+        await self.adapter.broadcast_to_other(
+            MSG_TYPE_SIGNED_CHOKE, sc.encode())
+        await self._on_signed_choke(sc)  # count our own choke
+
+    # -- timeouts ----------------------------------------------------------
+
+    async def _on_timeout(self, t: _Timeout) -> None:
+        if t.height != self.height or t.round != self.round:
+            return
+        if t.step == Step.PROPOSE and self.step == Step.PROPOSE:
+            # No (valid) proposal in time: prevote nil.
+            await self._cast_prevote(self.round, NIL_HASH)
+        elif t.step == Step.PREVOTE and self.step == Step.PREVOTE:
+            # No polka in time: precommit nil.
+            await self._cast_precommit(self.round, NIL_HASH)
+        elif t.step == Step.PRECOMMIT and self.step == Step.PRECOMMIT:
+            # No commit QC: brake — broadcast choke until the round moves.
+            self.step = Step.BRAKE
+            await self._broadcast_choke()
+            self._set_timer(Step.BRAKE, self.timer_config.brake_ratio)
+        elif t.step == Step.BRAKE and self.step == Step.BRAKE:
+            await self._broadcast_choke()
+            self._set_timer(Step.BRAKE, self.timer_config.brake_ratio)

@@ -1,0 +1,61 @@
+"""File-backed write-ahead log.
+
+Mirrors the reference's ConsensusWal (src/consensus.rs:295-332): one
+overwrite-in-place file `<wal_path>/overlord.wal` with set/get semantics, the
+directory auto-created at construction (src/consensus.rs:303-311), a lock
+guarding concurrent save/load (src/consensus.rs:299), and load returning None
+when nothing was ever saved (src/consensus.rs:324-331).
+
+The overwrite is made atomic via write-to-temp + rename (an improvement over
+the reference's bare fs::write, which can tear on crash mid-write)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+OVERLORD_WAL_NAME = "overlord.wal"  # reference src/consensus.rs:301
+
+
+class FileWal:
+    def __init__(self, wal_path: str):
+        os.makedirs(wal_path, exist_ok=True)
+        self._path = os.path.join(wal_path, OVERLORD_WAL_NAME)
+        self._tmp_path = self._path + ".tmp"
+        self._lock = asyncio.Lock()
+
+    async def save(self, data: bytes) -> None:
+        async with self._lock:
+            await asyncio.to_thread(self._write_atomic, bytes(data))
+
+    def _write_atomic(self, data: bytes) -> None:
+        with open(self._tmp_path, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(self._tmp_path, self._path)
+
+    async def load(self) -> Optional[bytes]:
+        async with self._lock:
+            return await asyncio.to_thread(self._read)
+
+    def _read(self) -> Optional[bytes]:
+        try:
+            with open(self._path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+class MemoryWal:
+    """In-process WAL for simulations and tests."""
+
+    def __init__(self):
+        self._data: Optional[bytes] = None
+
+    async def save(self, data: bytes) -> None:
+        self._data = bytes(data)
+
+    async def load(self) -> Optional[bytes]:
+        return self._data

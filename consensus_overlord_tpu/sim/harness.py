@@ -1,0 +1,148 @@
+"""Multi-validator simulation harness: N engines + router + fake controller
+in one asyncio loop — the minimum end-to-end slice of SURVEY.md §7 and the
+scaffold for the BASELINE.md fleet configs."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from ..core.types import Address, Commit, Hash, Node, Status, decode_wire_message
+from ..crypto.provider import CryptoProvider, Ed25519Crypto
+from ..engine.smr import Engine
+from ..engine.wal import MemoryWal
+from ..ports import Wal
+from .controller import SimController
+from .router import Router
+
+logger = logging.getLogger("consensus_overlord_tpu.sim")
+
+
+class SimAdapter:
+    """ConsensusAdapter wired to the sim router + fake controller — the
+    in-process Brain (reference src/consensus.rs:491-780)."""
+
+    def __init__(self, name: Address, router: Router,
+                 controller: SimController):
+        self.name = bytes(name)
+        self.router = router
+        self.controller = controller
+        self.view_changes: List[tuple[int, int, str]] = []
+        self.errors: List[str] = []
+
+    async def get_block(self, height: int):
+        content, block_hash = await self.controller.get_proposal(height)
+        return content, block_hash
+
+    async def check_block(self, height: int, block_hash: Hash,
+                          content: bytes) -> bool:
+        return await self.controller.check_proposal(height, block_hash, content)
+
+    async def commit(self, height: int, commit: Commit) -> Optional[Status]:
+        return await self.controller.commit_block(self.name, height, commit)
+
+    async def get_authority_list(self, height: int) -> List[Node]:
+        return self.controller.authority_list()
+
+    async def broadcast_to_other(self, msg_type: str, payload: bytes) -> None:
+        await self.router.broadcast(self.name, msg_type, payload)
+
+    async def transmit_to_relayer(self, relayer: Address, msg_type: str,
+                                  payload: bytes) -> None:
+        await self.router.send(self.name, relayer, msg_type, payload)
+
+    def report_error(self, context: str) -> None:
+        self.errors.append(context)
+        logger.warning("[%s] error: %s", self.name[:4].hex(), context)
+
+    def report_view_change(self, height: int, round: int, reason: str) -> None:
+        self.view_changes.append((height, round, reason))
+        logger.info("[%s] view change h=%d r=%d: %s",
+                    self.name[:4].hex(), height, round, reason)
+
+
+class SimNode:
+    """One validator: crypto + WAL + adapter + engine + network registration."""
+
+    def __init__(self, crypto: CryptoProvider, router: Router,
+                 controller: SimController, wal: Optional[Wal] = None):
+        self.crypto = crypto
+        self.wal = wal if wal is not None else MemoryWal()
+        self.adapter = SimAdapter(crypto.pub_key, router, controller)
+        self.engine = Engine(crypto.pub_key, self.adapter, crypto, self.wal)
+        self.router = router
+        self._task: Optional[asyncio.Task] = None
+        router.register(crypto.pub_key, self._on_network_msg)
+
+    @property
+    def name(self) -> bytes:
+        return self.crypto.pub_key
+
+    async def _on_network_msg(self, sender: Address, msg_type: str,
+                              payload: bytes) -> None:
+        """Inbound path: decode-and-inject, logging-and-dropping garbage
+        (the reference's proc_network_msg, src/consensus.rs:210-262)."""
+        try:
+            msg = decode_wire_message(msg_type, payload)
+        except Exception:  # noqa: BLE001 — malformed input is never fatal
+            logger.warning("[%s] dropped malformed %s", self.name[:4].hex(),
+                           msg_type)
+            return
+        self.engine.handler.send_msg(msg)
+
+    def start(self, init_height: int, interval_ms: int,
+              authority_list: Sequence[Node]) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self.engine.run(init_height, interval_ms, list(authority_list)))
+
+    async def stop(self) -> None:
+        self.engine.stop()
+        self.router.unregister(self.name)
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, 5.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._task.cancel()
+            self._task = None
+
+
+class SimNetwork:
+    """A fleet of N in-process validators running real consensus."""
+
+    def __init__(self, n_validators: int = 4, block_interval_ms: int = 200,
+                 seed: int = 0, drop_rate: float = 0.0,
+                 delay_range: tuple[float, float] = (0.0, 0.0),
+                 crypto_factory=None):
+        if crypto_factory is None:
+            crypto_factory = lambda i: Ed25519Crypto(  # noqa: E731
+                i.to_bytes(4, "big") * 8)
+        self.router = Router(seed=seed, drop_rate=drop_rate,
+                             delay_range=delay_range)
+        cryptos = [crypto_factory(i) for i in range(n_validators)]
+        self.controller = SimController(
+            [c.pub_key for c in cryptos], block_interval_ms)
+        self.nodes = [SimNode(c, self.router, self.controller)
+                      for c in cryptos]
+        self.controller.on_new_height.append(self._push_status)
+
+    def _push_status(self, height: int) -> None:
+        """Reconfigure-push: hand every engine the next-height Status, as the
+        CITA-Cloud controller does after each committed block; engines ignore
+        stale heights, lagging engines jump forward (resync)."""
+        status = self.controller.next_status(height)
+        for node in self.nodes:
+            if node._task is not None and not node._task.done():
+                node.engine.handler.send_msg(status)
+
+    def start(self, init_height: int = 0) -> None:
+        authority = self.controller.authority_list()
+        for node in self.nodes:
+            node.start(init_height, self.controller.block_interval_ms,
+                       authority)
+
+    async def run_until_height(self, height: int, timeout: float = 30.0) -> None:
+        await self.controller.wait_for_height(height, timeout)
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(n.stop() for n in self.nodes))

@@ -1,0 +1,94 @@
+"""Asyncio network router: the in-process stand-in for the CITA-Cloud
+network microservice.
+
+Implements the same two primitives the reference consumes over gRPC —
+broadcast-to-all-others and point-to-point send (reference
+src/consensus.rs:710, 762; origin routing rule src/util.rs:93-97) — plus
+deterministic fault injection: message drop, delivery delay, and network
+partitions."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable, Dict, List, Optional, Set
+
+from ..core.types import Address
+
+# handler(sender, msg_type, payload)
+Handler = Callable[[Address, str, bytes], Awaitable[None]]
+
+
+class Router:
+    def __init__(self, seed: int = 0, drop_rate: float = 0.0,
+                 delay_range: tuple[float, float] = (0.0, 0.0)):
+        self._handlers: Dict[Address, Handler] = {}
+        self._rng = random.Random(seed)
+        self.drop_rate = drop_rate
+        self.delay_range = delay_range
+        self._partitions: Optional[List[Set[Address]]] = None
+        self.delivered = 0
+        self.dropped = 0
+
+    def register(self, address: Address, handler: Handler) -> None:
+        """The reference's register_network_msg_handler equivalent
+        (src/main.rs:190-204)."""
+        self._handlers[bytes(address)] = handler
+
+    def unregister(self, address: Address) -> None:
+        self._handlers.pop(bytes(address), None)
+
+    def set_partition(self, *groups: Set[Address]) -> None:
+        """Partition the network into the given groups; nodes in different
+        groups cannot reach each other.  Call with no args to heal."""
+        self._partitions = [set(g) for g in groups] if groups else None
+
+    def _can_reach(self, a: Address, b: Address) -> bool:
+        if self._partitions is None:
+            return True
+        for group in self._partitions:
+            if a in group:
+                return b in group
+        return False  # unlisted nodes are isolated
+
+    async def broadcast(self, sender: Address, msg_type: str,
+                        payload: bytes) -> None:
+        """Deliver to every *other* registered node (origin 0 semantics,
+        reference src/consensus.rs:673-710)."""
+        for addr in list(self._handlers):
+            if addr != sender:
+                self._deliver(sender, addr, msg_type, payload)
+
+    async def send(self, sender: Address, target: Address, msg_type: str,
+                   payload: bytes) -> None:
+        """Point-to-point delivery (send_msg semantics, reference
+        src/consensus.rs:721-762)."""
+        self._deliver(sender, bytes(target), msg_type, payload)
+
+    def _deliver(self, sender: Address, target: Address, msg_type: str,
+                 payload: bytes) -> None:
+        handler = self._handlers.get(target)
+        if handler is None:
+            return
+        if not self._can_reach(sender, target):
+            self.dropped += 1
+            return
+        if self.drop_rate and self._rng.random() < self.drop_rate:
+            self.dropped += 1
+            return
+        delay = 0.0
+        if self.delay_range[1] > 0:
+            delay = self._rng.uniform(*self.delay_range)
+        loop = asyncio.get_running_loop()
+
+        def _fire() -> None:
+            self.delivered += 1
+            task = loop.create_task(handler(sender, msg_type, payload))
+            # Swallow handler failures (BFT drop); cancelled() guard keeps
+            # loop shutdown from logging CancelledError via this callback.
+            task.add_done_callback(lambda t: t.cancelled() or t.exception())
+
+        if delay > 0:
+            loop.call_later(delay, _fire)
+        else:
+            loop.call_soon(_fire)

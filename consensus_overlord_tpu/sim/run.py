@@ -1,0 +1,72 @@
+"""CLI entry for the simulation harness.
+
+    python -m consensus_overlord_tpu.sim.run --validators 4 --heights 5
+
+Runs an in-process validator fleet until the target height, printing per-
+height commit latency and a one-line JSON summary (the shape bench.py
+builds on)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="in-process consensus fleet")
+    parser.add_argument("--validators", type=int, default=4)
+    parser.add_argument("--heights", type=int, default=5)
+    parser.add_argument("--interval-ms", type=int, default=100)
+    parser.add_argument("--drop-rate", type=float, default=0.0)
+    parser.add_argument("--crypto", choices=["ed25519", "bls"],
+                        default="ed25519")
+    parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.WARNING,
+        format="%(asctime)s %(message)s")
+
+    from . import SimNetwork
+
+    if args.crypto == "bls":
+        from ..crypto.provider import CpuBlsCrypto
+
+        factory = lambda i: CpuBlsCrypto(0x1000 + 7919 * i)  # noqa: E731
+    else:
+        factory = None
+
+    async def run() -> dict:
+        net = SimNetwork(n_validators=args.validators,
+                         block_interval_ms=args.interval_ms,
+                         drop_rate=args.drop_rate, crypto_factory=factory)
+        net.start(init_height=1)
+        t0 = time.perf_counter()
+        last = t0
+        for h in range(1, args.heights + 1):
+            await net.run_until_height(h, timeout=args.timeout)
+            now = time.perf_counter()
+            print(f"height {h} committed (+{(now - last) * 1000:.1f} ms)")
+            last = now
+        total = time.perf_counter() - t0
+        await net.stop()
+        return {
+            "metric": "consensus-rounds",
+            "validators": args.validators,
+            "heights": args.heights,
+            "crypto": args.crypto,
+            "total_s": round(total, 3),
+            "ms_per_height": round(total * 1000 / args.heights, 1),
+            "delivered": net.router.delivered,
+            "dropped": net.router.dropped,
+        }
+
+    print(json.dumps(asyncio.run(run())))
+
+
+if __name__ == "__main__":
+    main()
