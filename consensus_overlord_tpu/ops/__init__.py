@@ -1,0 +1,11 @@
+"""TPU-native math ops: batched big-integer prime-field arithmetic and
+elliptic-curve kernels under jax.jit / vmap / shard_map.
+
+This package is the device-side analog of the reference's native crypto
+(blst C/assembly behind ophelia-blst, reference src/consensus.rs:336-337):
+where blst verifies one signature at a time on the CPU, these ops verify
+*batches* of signatures data-parallel across TPU lanes (SURVEY.md §2.3
+"Data-parallel crypto").
+"""
+
+from .field import FieldSpec, BLS12_381_FQ  # noqa: F401

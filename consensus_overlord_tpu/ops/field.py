@@ -1,0 +1,341 @@
+"""Batched prime-field arithmetic on TPU-native int32 lanes.
+
+Big-field modular arithmetic is the substrate under every curve op the
+framework runs on device (SURVEY.md §7 hard part (a): 381-bit modulus on
+int-limited TPU lanes).  Design:
+
+* An element of F_p is a vector of ``n`` limbs of ``b`` bits each, stored in
+  an int32 lane dimension (the trailing axis).  ``b = 10`` for BLS12-381
+  (n = 39 limbs): a full schoolbook product convolution — up to n partial
+  products of 2(b+2)-bit terms — stays strictly below 2**31, so every
+  intermediate is exact in int32.  No int64, no floats: everything maps onto
+  the TPU's native integer VPU lanes, and the limb axis is a vectorized axis
+  XLA tiles.
+
+* Limbs are kept **loose**: any limb value ≤ ``loose_max`` (2**(b+2) − 1)
+  is legal, and values are only congruent-mod-p, not canonical.  Operations
+  take loose inputs to loose outputs via a static *reduction pipeline*
+  (parallel carry passes + fold-matrix multiplies) whose per-position
+  worst-case bounds are tracked in exact Python integers at trace time; the
+  pipeline is re-planned until every bound fits int32 and the output is
+  loose.  Overflow-freedom is a build-time theorem, not a runtime hope.
+  Convergence relies on b·n exceeding the modulus width by a few slack
+  bits, which keeps the top limb of every fold row tiny.
+
+* Canonicalization (exact strict digits, value < p) happens only at
+  boundaries — equality tests, zero tests, serialization — via a
+  ``lax.scan`` ripple carry plus a conditional-subtraction ladder of
+  2**k·p multiples.
+
+Batching: every op broadcasts over arbitrary leading axes; a batch of B
+field elements is a (B, n) int32 array.  All ops are jit-safe and
+shard_map-safe (no data-dependent shapes or Python control flow on traced
+values).
+
+Reference anchor: this replaces the limb arithmetic inside blst
+(C/assembly) that the reference reaches through ophelia-blst
+(reference src/consensus.rs:336-337, Cargo.toml:20).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+
+_I32_MAX = 2**31 - 1
+
+
+def _digits(v: int, b: int, n: int) -> List[int]:
+    """Base-2**b digits of v, little-endian, exactly n of them (top digit
+    absorbs any excess)."""
+    mask = (1 << b) - 1
+    out = [(v >> (b * i)) & mask for i in range(n - 1)]
+    out.append(v >> (b * (n - 1)))
+    return out
+
+
+class FieldSpec:
+    """A prime field F_p with a fixed limb layout and precomputed reduction
+    tables.  Instances are cheap singletons; all methods are pure functions
+    over int32 arrays whose trailing axis is the limb axis."""
+
+    def __init__(self, p: int, limb_bits: int = 10, name: str = "F_p"):
+        self.p = p
+        self.b = limb_bits
+        self.name = name
+        self.mask = (1 << limb_bits) - 1
+        self.n = -(-p.bit_length() // limb_bits)
+        # Two spare bits per limb: loose limbs may reach 4·2**b − 1.  The
+        # planner needs the slack to absorb fold carries (see _reduce).
+        self.loose_max = (1 << (limb_bits + 2)) - 1
+        b, n = self.b, self.n
+        assert self.n * self.loose_max**2 <= _I32_MAX, (
+            "limb width too large: product convolution would overflow int32")
+        assert b * n - p.bit_length() >= 2, (
+            "need ≥2 slack bits so fold-row top limbs stay tiny")
+
+        # Fold rows: row k is the limb decomposition of 2**(b·(n+k)) mod p,
+        # used to fold positions ≥ n of a wide accumulator back into the
+        # low n positions.  Enough rows for a full product + carry growth.
+        n_rows = n + 8
+        self._fold_np = np.array(
+            [_digits(pow(2, b * (n + k), p), b, n) for k in range(n_rows)],
+            dtype=np.int64)
+        assert self._fold_np.max() <= self.mask
+        self._fold = jnp.asarray(self._fold_np, dtype=jnp.int32)
+
+        # Conditional-subtraction ladder for canonicalization: strict-digit
+        # values are < 2**(b·n) ≤ 2**(J+1)·p, so descending over 2**J·p …
+        # 1·p lands < p.
+        j_top = b * n - p.bit_length()
+        self._ladder = [1 << j for j in range(j_top, -1, -1)]
+        self._kp = {
+            k: jnp.asarray(_digits(k * p, b, n), dtype=jnp.int32)
+            for k in self._ladder
+        }
+
+        # Subtraction pad: a multiple of p whose limb form has every limb
+        # ≥ loose_max, so (x + PAD − y) is limb-wise non-negative for any
+        # loose x, y.  Found by massaging the digits of m·p bottom-up.
+        self._pad_np = self._build_pad()
+        self._pad = jnp.asarray(self._pad_np, dtype=jnp.int32)
+
+        self._one_np = np.array(_digits(1, b, n), dtype=np.int64)
+
+        # Dry-run the mul/add/sub reduction plans once so an unreducible
+        # layout fails at spec construction, not first trace.
+        for bounds in (self._conv_bounds(),
+                       [2 * self.loose_max] * n,
+                       [self.loose_max + int(self._pad_np.max())] * n):
+            self._plan(list(bounds))
+
+    # -- construction of constants ------------------------------------------
+
+    def _build_pad(self) -> np.ndarray:
+        b, n, L = self.b, self.n, self.loose_max
+        hi_cap = 3 * (1 << b) + L
+        for m in range(1, 1 << (b + 3)):
+            v = m * self.p
+            if v >= 1 << (b * (n - 1) + b + 3):
+                break  # top digit no longer fits comfortably
+            d = _digits(v, b, n)
+            ok = True
+            for i in range(n - 1):
+                if d[i] < L:
+                    need = -(-(L - d[i]) >> b)  # ceil division by 2**b
+                    d[i] += need << b
+                    d[i + 1] -= need
+                if not (L <= d[i] <= hi_cap):
+                    ok = False
+                    break
+            if ok and L <= d[n - 1] <= hi_cap:
+                assert sum(di << (b * i) for i, di in enumerate(d)) == v
+                return np.array(d, dtype=np.int64)
+        raise AssertionError(f"no subtraction pad found for {self.name}")
+
+    # -- loose-pipeline internals -------------------------------------------
+
+    def _plan(self, bounds: List[int]) -> List[Tuple[str, int]]:
+        """Static reduction plan for the given per-position bounds: a list
+        of ('fold', k) / ('carry', extend) steps ending with width n and all
+        bounds ≤ loose_max.  Pure bound arithmetic — raises if no safe plan
+        exists."""
+        b, n, mask = self.b, self.n, self.mask
+        steps: List[Tuple[str, int]] = []
+        for _ in range(256):
+            if len(bounds) <= n and max(bounds) <= self.loose_max:
+                if len(bounds) < n:
+                    steps.append(("pad", n - len(bounds)))
+                    bounds += [0] * (n - len(bounds))
+                return steps
+            m = len(bounds)
+            if m > n:
+                k = m - n
+                fold_np = self._fold_np[:k]
+                out_bounds = [
+                    bounds[j] + int(sum(bounds[n + r] * fold_np[r, j]
+                                        for r in range(k)))
+                    for j in range(n)
+                ]
+                if max(out_bounds) <= _I32_MAX:
+                    steps.append(("fold", k))
+                    bounds = out_bounds
+                    continue
+            extend = 1 if bounds[-1] > mask else 0
+            if extend:
+                bounds.append(0)
+            steps.append(("carry", extend))
+            bounds = [min(bounds[i], mask) +
+                      (bounds[i - 1] >> b if i else 0)
+                      for i in range(len(bounds))]
+        raise AssertionError(f"reduction plan did not converge for {self.name}")
+
+    def _reduce(self, x: Array, bounds: Sequence[int]) -> Array:
+        """Reduce a wide non-negative accumulator (trailing axis = positions,
+        per-position upper bounds as exact Python ints) to n loose limbs
+        congruent mod p, following the statically planned, provably
+        overflow-free step sequence."""
+        b, n, mask = self.b, self.n, self.mask
+        assert x.shape[-1] == len(bounds)
+        for step, arg in self._plan(list(bounds)):
+            if step == "pad":
+                x = jnp.concatenate(
+                    [x, jnp.zeros(x.shape[:-1] + (arg,), jnp.int32)], axis=-1)
+            elif step == "fold":
+                lo, hi = x[..., :n], x[..., n:]
+                x = lo + jnp.einsum("...k,kj->...j", hi, self._fold[:arg])
+            else:  # carry
+                if arg:
+                    x = jnp.concatenate(
+                        [x, jnp.zeros(x.shape[:-1] + (1,), jnp.int32)],
+                        axis=-1)
+                c = x >> b
+                x = (x & mask) + jnp.concatenate(
+                    [jnp.zeros(x.shape[:-1] + (1,), jnp.int32), c[..., :-1]],
+                    axis=-1)
+        return x
+
+    def _conv_bounds(self) -> List[int]:
+        n, L = self.n, self.loose_max
+        return [(min(i, n - 1) - max(0, i - n + 1) + 1) * L * L
+                for i in range(2 * n - 1)]
+
+    # -- arithmetic (loose → loose) -----------------------------------------
+
+    def add(self, x: Array, y: Array) -> Array:
+        return self._reduce(x + y, [2 * self.loose_max] * self.n)
+
+    def sub(self, x: Array, y: Array) -> Array:
+        z = x + (self._pad - y)
+        bound = self.loose_max + int(self._pad_np.max())
+        return self._reduce(z, [bound] * self.n)
+
+    def neg(self, x: Array) -> Array:
+        return self._reduce(self._pad - x, [int(self._pad_np.max())] * self.n)
+
+    def mul(self, x: Array, y: Array) -> Array:
+        n = self.n
+        shape = jnp.broadcast_shapes(x.shape[:-1], y.shape[:-1])
+        out = jnp.zeros(shape + (2 * n - 1,), jnp.int32)
+        for i in range(n):
+            out = out.at[..., i:i + n].add(x[..., i:i + 1] * y)
+        return self._reduce(out, self._conv_bounds())
+
+    def sq(self, x: Array) -> Array:
+        return self.mul(x, x)
+
+    def mul_small(self, x: Array, k: int) -> Array:
+        assert 0 <= k and k * self.loose_max <= _I32_MAX
+        return self._reduce(x * k, [k * self.loose_max] * self.n)
+
+    def pow_static(self, x: Array, e: int) -> Array:
+        """x**e mod p for a static Python-int exponent, via an MSB-first
+        square-and-multiply under lax.scan (compile-time O(1) graph)."""
+        if e == 0:
+            return jnp.broadcast_to(self.one(), x.shape).astype(jnp.int32)
+        assert e > 0
+        bits = [int(c) for c in bin(e)[3:]]  # after the leading 1 bit
+        if not bits:
+            return x
+
+        def step(acc, bit):
+            acc = self.mul(acc, acc)
+            acc = jnp.where(bit.astype(bool), self.mul(acc, x), acc)
+            return acc, None
+
+        acc, _ = lax.scan(step, x, jnp.asarray(bits, jnp.int32))
+        return acc
+
+    def inv(self, x: Array) -> Array:
+        """Modular inverse by Fermat (x**(p−2)); inv(0) = 0."""
+        return self.pow_static(x, self.p - 2)
+
+    def sqrt_candidate(self, x: Array) -> Array:
+        """x**((p+1)/4) — a square root of x when one exists (p ≡ 3 mod 4).
+        Callers must check sq(result) == x."""
+        assert self.p % 4 == 3
+        return self.pow_static(x, (self.p + 1) // 4)
+
+    # -- canonicalization / predicates --------------------------------------
+
+    def _scan_carry(self, x: Array) -> Tuple[Array, Array]:
+        """Exact ripple carry over the limb axis (signed-safe: arithmetic
+        shift + two's-complement mask keep floor semantics).  Returns
+        (digits each in [0, 2**b), carry-out)."""
+        b, mask = self.b, self.mask
+        xm = jnp.moveaxis(x, -1, 0)
+
+        def step(c, xi):
+            t = xi + c
+            return t >> b, t & mask
+
+        c, ym = lax.scan(step, jnp.zeros(x.shape[:-1], jnp.int32), xm)
+        return jnp.moveaxis(ym, 0, -1), c
+
+    def strict(self, x: Array) -> Array:
+        """Canonical strict digits of x mod p (each < 2**b, value < p).
+        Input must be loose (limbs ≤ loose_max)."""
+        over = self._fold[0]  # 2**(b·n) mod p
+        for _ in range(2):
+            x, c = self._scan_carry(x)
+            x = x + c[..., None] * over
+        x, _ = self._scan_carry(x)  # carry provably 0 here (≥2 slack bits)
+        for k in self._ladder:
+            x = self._cond_sub(x, self._kp[k])
+        return x
+
+    def _cond_sub(self, x: Array, kp: Array) -> Array:
+        d, borrow = self._scan_carry(x - kp)
+        return jnp.where((borrow == 0)[..., None], d, x)
+
+    def is_zero(self, x: Array) -> Array:
+        return jnp.all(self.strict(x) == 0, axis=-1)
+
+    def eq(self, x: Array, y: Array) -> Array:
+        return jnp.all(self.strict(x) == self.strict(y), axis=-1)
+
+    # -- conversions ---------------------------------------------------------
+
+    def one(self) -> Array:
+        return jnp.asarray(self._one_np, dtype=jnp.int32)
+
+    def zero(self) -> Array:
+        return jnp.zeros((self.n,), jnp.int32)
+
+    def from_int(self, v: int) -> np.ndarray:
+        return np.array(_digits(v % self.p, self.b, self.n), dtype=np.int32)
+
+    def from_ints(self, vs: Sequence[int]) -> np.ndarray:
+        return np.stack([self.from_int(v) for v in vs])
+
+    def to_ints(self, x: Array) -> List[int]:
+        """Host-side: canonical integer values of a (..., n) limb array,
+        flattened C-order."""
+        arr = np.asarray(jax.device_get(self.strict(x)), dtype=np.int64)
+        flat = arr.reshape(-1, self.n)
+        return [int(sum(int(d) << (self.b * i) for i, d in enumerate(row)))
+                for row in flat]
+
+    def to_int(self, x: Array) -> int:
+        (v,) = self.to_ints(x)
+        return v
+
+
+# Moduli of the curve families the framework targets (BASELINE.md configs):
+# BLS12-381 is the reference's signature curve (src/consensus.rs:336-337);
+# Ed25519 / secp256k1 / SM2 back the large-fleet simulation configs.
+BLS12_381_P = int(
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+    "1eabfffeb153ffffb9feffffffffaaab", 16)
+ED25519_P = 2**255 - 19
+SECP256K1_P = 2**256 - 2**32 - 977
+SM2_P = int("fffffffeffffffffffffffffffffffffffffffff"
+            "00000000ffffffffffffffff", 16)
+
+BLS12_381_FQ = FieldSpec(BLS12_381_P, limb_bits=10, name="bls12381_fq")

@@ -1,19 +1,27 @@
 """Test configuration.
 
 Multi-chip sharding is tested on a virtual 8-device CPU mesh: real TPU
-hardware in the dev loop is a single chip, so tests force the CPU platform
-with 8 host devices before JAX initializes (see task spec / SURVEY.md §7
-build order step 6).
+hardware in the dev loop is a single chip behind a high-latency relay, so
+tests force the CPU platform with 8 host devices (see task spec / SURVEY.md
+§7 build order step 6).
+
+The TPU relay registers its PJRT plugin from a sitecustomize hook at
+interpreter startup and sets JAX_PLATFORMS for the whole environment, so
+the env-var route is already lost by the time pytest imports this file.
+JAX backends initialize lazily, though — overriding the platform through
+jax.config before the first backend use reliably pins tests to CPU.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (after XLA_FLAGS so the CPU backend sees it)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,167 @@
+"""ops/field.py against exact Python big-int arithmetic (the oracle)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_overlord_tpu.ops.field import (
+    BLS12_381_FQ, BLS12_381_P, ED25519_P, SECP256K1_P, SM2_P, FieldSpec)
+
+F = BLS12_381_FQ
+P = BLS12_381_P
+RNG = random.Random(0xF1E1D)
+
+
+def rand_elems(k):
+    return [RNG.randrange(P) for _ in range(k)]
+
+
+def loosen(spec, v):
+    """A random non-canonical loose representation of v (limbs up to
+    loose_max), to prove ops accept the full loose domain."""
+    digits = list(spec.from_int(v % spec.p).astype(int))
+    for _ in range(200):
+        i = RNG.randrange(spec.n - 1)
+        room = spec.loose_max - digits[i]
+        if digits[i + 1] >= 1 and room >= (1 << spec.b):
+            digits[i] += 1 << spec.b
+            digits[i + 1] -= 1
+    return np.array(digits, dtype=np.int32)
+
+
+class TestConversions:
+    def test_roundtrip(self):
+        vals = [0, 1, 2, P - 1, P // 2] + rand_elems(16)
+        x = jnp.asarray(F.from_ints(vals))
+        assert F.to_ints(x) == [v % P for v in vals]
+
+    def test_loose_roundtrip(self):
+        vals = rand_elems(8)
+        x = jnp.asarray(np.stack([loosen(F, v) for v in vals]))
+        assert int(np.max(np.asarray(x))) > F.mask  # actually loose
+        assert F.to_ints(x) == vals
+
+
+class TestArithmetic:
+    def test_add_sub_mul_batch(self):
+        a = rand_elems(32)
+        b = rand_elems(32)
+        xa = jnp.asarray(np.stack([loosen(F, v) for v in a]))
+        xb = jnp.asarray(np.stack([loosen(F, v) for v in b]))
+        assert F.to_ints(F.add(xa, xb)) == [(u + v) % P for u, v in zip(a, b)]
+        assert F.to_ints(F.sub(xa, xb)) == [(u - v) % P for u, v in zip(a, b)]
+        assert F.to_ints(F.mul(xa, xb)) == [(u * v) % P for u, v in zip(a, b)]
+        assert F.to_ints(F.neg(xa)) == [(-u) % P for u in a]
+        assert F.to_ints(F.sq(xa)) == [u * u % P for u in a]
+
+    def test_edge_values(self):
+        edges = [0, 1, P - 1, P - 2, (P - 1) // 2, (P + 1) // 2]
+        for u in edges:
+            for v in edges:
+                xu, xv = jnp.asarray(F.from_int(u)), jnp.asarray(F.from_int(v))
+                assert F.to_int(F.mul(xu, xv)) == u * v % P
+                assert F.to_int(F.add(xu, xv)) == (u + v) % P
+                assert F.to_int(F.sub(xu, xv)) == (u - v) % P
+
+    def test_all_max_loose_limbs(self):
+        """Adversarial worst case: every limb at loose_max on both inputs."""
+        digits = np.full((F.n,), F.loose_max, dtype=np.int32)
+        v = sum(int(d) << (F.b * i) for i, d in enumerate(digits)) % P
+        x = jnp.asarray(digits)
+        assert F.to_int(F.mul(x, x)) == v * v % P
+        assert F.to_int(F.add(x, x)) == 2 * v % P
+        assert F.to_int(F.sub(x, x)) == 0
+
+    def test_mul_small(self):
+        a = rand_elems(8)
+        xa = jnp.asarray(F.from_ints(a))
+        for k in (0, 1, 2, 3, 4, 12, 1000):
+            assert F.to_ints(F.mul_small(xa, k)) == [u * k % P for u in a]
+
+    def test_chained_ops_stay_loose(self):
+        """Outputs of ops must be legal inputs to further ops (loose domain
+        closure) — run a deep random chain and compare against the oracle."""
+        a, b = rand_elems(2)
+        x, y = jnp.asarray(F.from_int(a)), jnp.asarray(F.from_int(b))
+        va, vb = a, b
+        for i in range(50):
+            op = RNG.choice(["add", "sub", "mul", "sq"])
+            if op == "add":
+                x, va = F.add(x, y), (va + vb) % P
+            elif op == "sub":
+                x, va = F.sub(x, y), (va - vb) % P
+            elif op == "mul":
+                x, va = F.mul(x, y), (va * vb) % P
+            else:
+                y, vb = F.sq(y), vb * vb % P
+            assert int(np.max(np.abs(np.asarray(x)))) <= F.loose_max
+        assert F.to_int(x) == va
+        assert F.to_int(y) == vb
+
+
+class TestPowInvSqrt:
+    def test_pow(self):
+        a = rand_elems(4)
+        xa = jnp.asarray(F.from_ints(a))
+        for e in (1, 2, 3, 65537, RNG.randrange(P)):
+            assert F.to_ints(F.pow_static(xa, e)) == [pow(u, e, P) for u in a]
+
+    def test_inv(self):
+        a = [1, 2, P - 1] + rand_elems(5)
+        xa = jnp.asarray(F.from_ints(a))
+        assert F.to_ints(F.inv(xa)) == [pow(u, -1, P) for u in a]
+
+    def test_inv_zero(self):
+        assert F.to_int(F.inv(jnp.asarray(F.from_int(0)))) == 0
+
+    def test_sqrt(self):
+        squares = [pow(u, 2, P) for u in rand_elems(6)]
+        xs = jnp.asarray(F.from_ints(squares))
+        roots = F.to_ints(F.sqrt_candidate(xs))
+        for r, s in zip(roots, squares):
+            assert r * r % P == s
+
+
+class TestPredicates:
+    def test_is_zero_eq(self):
+        a = rand_elems(4)
+        xa = jnp.asarray(F.from_ints(a))
+        assert list(np.asarray(F.is_zero(xa))) == [False] * 4
+        zero_loose = F.sub(xa, jnp.asarray(np.stack(
+            [loosen(F, v) for v in a])))
+        assert list(np.asarray(F.is_zero(zero_loose))) == [True] * 4
+        assert bool(F.eq(xa, jnp.asarray(F.from_ints(a))).all())
+
+    def test_strict_matches_canonical(self):
+        for v in [0, 1, P - 1] + rand_elems(4):
+            x = jnp.asarray(loosen(F, v))
+            got = np.asarray(F.strict(x)).astype(np.int64)
+            want = F.from_int(v).astype(np.int64)
+            assert (got == want).all()
+
+
+class TestOtherModuli:
+    @pytest.mark.parametrize("p", [ED25519_P, SECP256K1_P, SM2_P])
+    def test_generic_modulus(self, p):
+        spec = FieldSpec(p, limb_bits=10, name=f"f_{p % 1000}")
+        a = [RNG.randrange(p) for _ in range(8)]
+        b = [RNG.randrange(p) for _ in range(8)]
+        xa, xb = jnp.asarray(spec.from_ints(a)), jnp.asarray(spec.from_ints(b))
+        assert spec.to_ints(spec.mul(xa, xb)) == [
+            (u * v) % p for u, v in zip(a, b)]
+        assert spec.to_ints(spec.sub(xa, xb)) == [
+            (u - v) % p for u, v in zip(a, b)]
+        assert spec.to_ints(spec.inv(xa)) == [pow(u, -1, p) for u in a]
+
+
+class TestJit:
+    def test_ops_jit_and_vmap(self):
+        a, b = rand_elems(16), rand_elems(16)
+        xa, xb = jnp.asarray(F.from_ints(a)), jnp.asarray(F.from_ints(b))
+        mul_j = jax.jit(F.mul)
+        assert F.to_ints(mul_j(xa, xb)) == [(u * v) % P for u, v in zip(a, b)]
+        mul_v = jax.vmap(F.mul)
+        assert F.to_ints(mul_v(xa, xb)) == [(u * v) % P for u, v in zip(a, b)]
