@@ -1,0 +1,204 @@
+"""BLS12-381 G1/G2 group ops on device, plus host-side wire parsing.
+
+G1: y² = x³ + 4 over Fq (48-byte compressed points — signatures).
+G2: y² = x³ + 4(1+u) over Fq2 (96-byte compressed points — public keys,
+which double as validator addresses, reference src/consensus.rs:352-357).
+
+The split of labor mirrors SURVEY.md §7: flag-bit/byte-format validation is
+host-side numpy (cheap, O(1) per point); everything O(field-op) — curve
+membership, square roots for decompression, subgroup checks, scalar
+multiplication, aggregation — is batched on device.
+
+Wire format (ZCash compressed encoding) matches the host oracle
+crypto/bls12381.py, which is golden-tested against the scheme semantics of
+the reference (src/consensus.rs:385-463).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bls12381 as oracle
+from .curve import CurveOps, Point
+from .field import BLS12_381_FQ, Array
+from .fq2 import Fq2Ops
+
+FQ = BLS12_381_FQ
+FQ2 = Fq2Ops(FQ)
+
+# b = 4  →  b3 = 12;   b' = 4(1+u)  →  b3' = 12(1+u)
+G1 = CurveOps(FQ, lambda x: FQ.mul_small(x, 12), "bls12381_g1")
+G2 = CurveOps(FQ2, lambda x: FQ2.mul_small_xi(x, 12), "bls12381_g2")
+
+R = oracle.R  # subgroup order
+
+_FLAG_COMPRESSED = 0x80
+_FLAG_INFINITY = 0x40
+_FLAG_SIGN = 0x20
+_HALF_PLUS_1 = (oracle.P - 1) // 2 + 1
+
+
+def g1_generator(batch: int = 1) -> Point:
+    gx, gy = oracle.G1_GEN
+    x = jnp.broadcast_to(jnp.asarray(FQ.from_int(gx)), (batch, FQ.n))
+    y = jnp.broadcast_to(jnp.asarray(FQ.from_int(gy)), (batch, FQ.n))
+    return G1.from_affine(x, y)
+
+
+def g2_generator(batch: int = 1) -> Point:
+    (x0, x1), (y0, y1) = oracle.G2_GEN
+    x = jnp.broadcast_to(FQ2.from_ints([(x0, x1)]), (batch, 2, FQ.n))
+    y = jnp.broadcast_to(FQ2.from_ints([(y0, y1)]), (batch, 2, FQ.n))
+    return G2.from_affine(x, y)
+
+
+# ---------------------------------------------------------------------------
+# Host-side wire parsing (flag bits, range checks).  Returns numpy arrays
+# ready to ship to device; `wellformed` folds every host-detectable format
+# error so malformed input degrades to a False lane, never an exception —
+# the reference's log-and-drop posture (src/consensus.rs:220-260).
+# ---------------------------------------------------------------------------
+
+class ParsedG1(NamedTuple):
+    x: np.ndarray          # (B, n) limbs
+    sign: np.ndarray       # (B,) bool — lexicographically-largest flag
+    infinity: np.ndarray   # (B,) bool
+    wellformed: np.ndarray  # (B,) bool
+
+
+class ParsedG2(NamedTuple):
+    x: np.ndarray          # (B, 2, n) limbs
+    sign: np.ndarray
+    infinity: np.ndarray
+    wellformed: np.ndarray
+
+
+def parse_g1_compressed(blobs: Sequence[bytes]) -> ParsedG1:
+    b = len(blobs)
+    x = np.zeros((b, FQ.n), dtype=np.int32)
+    sign = np.zeros(b, dtype=bool)
+    inf = np.zeros(b, dtype=bool)
+    ok = np.zeros(b, dtype=bool)
+    for i, blob in enumerate(blobs):
+        if len(blob) != 48 or not blob[0] & _FLAG_COMPRESSED:
+            continue
+        flags = blob[0]
+        if flags & _FLAG_INFINITY:
+            if flags & _FLAG_SIGN or flags & 0x1F or any(blob[1:]):
+                continue
+            inf[i] = ok[i] = True
+            continue
+        xv = int.from_bytes(bytes([flags & 0x1F]) + blob[1:], "big")
+        if xv >= oracle.P:
+            continue
+        x[i] = FQ.from_int(xv)
+        sign[i] = bool(flags & _FLAG_SIGN)
+        ok[i] = True
+    return ParsedG1(x, sign, inf, ok)
+
+
+def parse_g2_compressed(blobs: Sequence[bytes]) -> ParsedG2:
+    b = len(blobs)
+    x = np.zeros((b, 2, FQ.n), dtype=np.int32)
+    sign = np.zeros(b, dtype=bool)
+    inf = np.zeros(b, dtype=bool)
+    ok = np.zeros(b, dtype=bool)
+    for i, blob in enumerate(blobs):
+        if len(blob) != 96 or not blob[0] & _FLAG_COMPRESSED:
+            continue
+        flags = blob[0]
+        if flags & _FLAG_INFINITY:
+            if flags & _FLAG_SIGN or flags & 0x1F or any(blob[1:]):
+                continue
+            inf[i] = ok[i] = True
+            continue
+        x1 = int.from_bytes(bytes([flags & 0x1F]) + blob[1:48], "big")
+        x0 = int.from_bytes(blob[48:], "big")
+        if x0 >= oracle.P or x1 >= oracle.P:
+            continue
+        x[i, 0] = FQ.from_int(x0)
+        x[i, 1] = FQ.from_int(x1)
+        sign[i] = bool(flags & _FLAG_SIGN)
+        ok[i] = True
+    return ParsedG2(x, sign, inf, ok)
+
+
+# ---------------------------------------------------------------------------
+# Device-side batched decompression: solve y² = x³ + b, pick the root by
+# the sign flag.  Returns (Point, valid) where invalid lanes (x not on
+# curve) carry garbage points flagged False.
+# ---------------------------------------------------------------------------
+
+def g1_decompress_device(x: Array, sign: Array, infinity: Array,
+                         wellformed: Array) -> Tuple[Point, Array]:
+    rhs = FQ.add(FQ.mul(FQ.sq(x), x), jnp.asarray(FQ.from_int(4)))
+    y = FQ.sqrt_candidate(rhs)
+    on_curve = FQ.eq(FQ.sq(y), rhs)
+    flip = FQ.geq_const(y, _HALF_PLUS_1) != sign
+    y = FQ.where(flip, FQ.neg(y), y)
+    pt = G1.from_affine(x, y)
+    pt = G1.select(infinity, G1.infinity_like(x), pt)
+    valid = wellformed & (on_curve | infinity)
+    return pt, valid
+
+
+def g2_decompress_device(x: Array, sign: Array, infinity: Array,
+                         wellformed: Array) -> Tuple[Point, Array]:
+    b_const = FQ2.from_ints([(4, 4)])[0]  # 4 + 4u
+    rhs = FQ2.add(FQ2.mul(FQ2.sq(x), x), b_const)
+    y, on_curve = FQ2.sqrt_checked(rhs)
+    flip = FQ2.is_lex_largest(y) != sign
+    y = FQ2.where(flip, FQ2.neg(y), y)
+    pt = G2.from_affine(x, y)
+    pt = G2.select(infinity, G2.infinity_like(x), pt)
+    valid = wellformed & (on_curve | infinity)
+    return pt, valid
+
+
+# ---------------------------------------------------------------------------
+# Subgroup membership: r·P == 𝒪.  (The r-torsion check blst performs before
+# pairing; batched here as one 255-iteration scan over the whole batch.)
+# ---------------------------------------------------------------------------
+
+def g1_in_subgroup(p: Point) -> Array:
+    return G1.is_infinity(G1.scalar_mul_static(p, R)) & G1.on_curve(p)
+
+
+def g2_in_subgroup(p: Point) -> Array:
+    return G2.is_infinity(G2.scalar_mul_static(p, R)) & G2.on_curve(p)
+
+
+# ---------------------------------------------------------------------------
+# Host conversions for cross-checking with the oracle.
+# ---------------------------------------------------------------------------
+
+def g1_to_oracle(p: Point) -> List:
+    x, y, inf = G1.to_affine(p)
+    xs, ys = FQ.to_ints(x), FQ.to_ints(y)
+    infs = np.asarray(inf).reshape(-1)
+    return [None if i else (xv, yv) for xv, yv, i in zip(xs, ys, infs)]
+
+
+def g2_to_oracle(p: Point) -> List:
+    x, y, inf = G2.to_affine(p)
+    xs, ys = FQ2.to_int_pairs(x), FQ2.to_int_pairs(y)
+    infs = np.asarray(inf).reshape(-1)
+    return [None if i else (xv, yv) for xv, yv, i in zip(xs, ys, infs)]
+
+
+def g1_from_oracle(pts: Sequence) -> Point:
+    xs = [0 if p is None else p[0] for p in pts]
+    ys = [1 if p is None else p[1] for p in pts]
+    zs = [0 if p is None else 1 for p in pts]
+    return Point(jnp.asarray(FQ.from_ints(xs)), jnp.asarray(FQ.from_ints(ys)),
+                 jnp.asarray(FQ.from_ints(zs)))
+
+
+def g2_from_oracle(pts: Sequence) -> Point:
+    xs = [(0, 0) if p is None else p[0] for p in pts]
+    ys = [(1, 0) if p is None else p[1] for p in pts]
+    zs = [(0, 0) if p is None else (1, 0) for p in pts]
+    return Point(FQ2.from_ints(xs), FQ2.from_ints(ys), FQ2.from_ints(zs))

@@ -300,6 +300,18 @@ class FieldSpec:
     def eq(self, x: Array, y: Array) -> Array:
         return jnp.all(self.strict(x) == self.strict(y), axis=-1)
 
+    def geq_const(self, x: Array, c: int) -> Array:
+        """(x mod p) ≥ c, elementwise over the batch.  c is a static
+        non-negative int < 2**(b·n)."""
+        digits = jnp.asarray(_digits(c, self.b, self.n), jnp.int32)
+        _, borrow = self._scan_carry(self.strict(x) - digits)
+        return borrow == 0
+
+    def where(self, mask: Array, x: Array, y: Array) -> Array:
+        """Select limb vectors by a batch-shaped boolean mask (broadcasts
+        over the limb axis)."""
+        return jnp.where(mask[..., None], x, y)
+
     # -- conversions ---------------------------------------------------------
 
     def one(self) -> Array:

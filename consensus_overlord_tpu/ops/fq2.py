@@ -1,0 +1,179 @@
+"""Batched Fq2 = Fq[u]/(u²+1) arithmetic for the BLS12-381 G2 group.
+
+Elements are (..., 2, n) int32 limb arrays — component axis then limb axis
+— so everything broadcasts over arbitrary leading batch dimensions and
+stays jit/vmap/shard_map-safe.  All control flow is branchless (selects),
+including the square root, so the ops vectorize across TPU lanes.
+
+This is the device analog of the host tower in crypto/bls12381.py (itself
+replacing the Fq2 arithmetic inside blst, reference src/consensus.rs:336).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .field import Array, FieldSpec
+
+
+class Fq2Ops:
+    """Quadratic extension ops over a base FieldSpec with u² = −1
+    (the BLS12-381 non-residue)."""
+
+    def __init__(self, fq: FieldSpec):
+        self.fq = fq
+        assert fq.p % 4 == 3, "u²=−1 is a non-residue only for p ≡ 3 mod 4"
+
+    # components -------------------------------------------------------------
+
+    @staticmethod
+    def c0(x: Array) -> Array:
+        return x[..., 0, :]
+
+    @staticmethod
+    def c1(x: Array) -> Array:
+        return x[..., 1, :]
+
+    @staticmethod
+    def build(c0: Array, c1: Array) -> Array:
+        return jnp.stack([c0, c1], axis=-2)
+
+    def one(self) -> Array:
+        return self.build(self.fq.one(), self.fq.zero())
+
+    def zero(self) -> Array:
+        return self.build(self.fq.zero(), self.fq.zero())
+
+    def from_ints(self, pairs) -> Array:
+        import numpy as np
+        return jnp.asarray(np.stack(
+            [np.stack([self.fq.from_int(a), self.fq.from_int(b)])
+             for a, b in pairs]))
+
+    def to_int_pairs(self, x: Array):
+        c0s = self.fq.to_ints(self.c0(x))
+        c1s = self.fq.to_ints(self.c1(x))
+        return list(zip(c0s, c1s))
+
+    # arithmetic -------------------------------------------------------------
+
+    def add(self, x: Array, y: Array) -> Array:
+        return self.build(self.fq.add(self.c0(x), self.c0(y)),
+                          self.fq.add(self.c1(x), self.c1(y)))
+
+    def sub(self, x: Array, y: Array) -> Array:
+        return self.build(self.fq.sub(self.c0(x), self.c0(y)),
+                          self.fq.sub(self.c1(x), self.c1(y)))
+
+    def neg(self, x: Array) -> Array:
+        return self.build(self.fq.neg(self.c0(x)), self.fq.neg(self.c1(x)))
+
+    def mul(self, x: Array, y: Array) -> Array:
+        # Karatsuba: (a0+a1u)(b0+b1u) = (a0b0 − a1b1) + ((a0+a1)(b0+b1) − a0b0 − a1b1)u
+        fq = self.fq
+        a0, a1, b0, b1 = self.c0(x), self.c1(x), self.c0(y), self.c1(y)
+        t0 = fq.mul(a0, b0)
+        t1 = fq.mul(a1, b1)
+        t2 = fq.mul(fq.add(a0, a1), fq.add(b0, b1))
+        return self.build(fq.sub(t0, t1), fq.sub(t2, fq.add(t0, t1)))
+
+    def sq(self, x: Array) -> Array:
+        # (a0² − a1²) + 2·a0·a1·u
+        fq = self.fq
+        a0, a1 = self.c0(x), self.c1(x)
+        return self.build(
+            fq.mul(fq.add(a0, a1), fq.sub(a0, a1)),
+            fq.mul_small(fq.mul(a0, a1), 2))
+
+    def mul_small(self, x: Array, k: int) -> Array:
+        return self.build(self.fq.mul_small(self.c0(x), k),
+                          self.fq.mul_small(self.c1(x), k))
+
+    def mul_small_xi(self, x: Array, k: int) -> Array:
+        """x · k·(1+u): used for the G2 curve constant b = 4(1+u) and its
+        triple b3 = 12(1+u)."""
+        fq = self.fq
+        a0, a1 = self.c0(x), self.c1(x)
+        return self.build(fq.mul_small(fq.sub(a0, a1), k),
+                          fq.mul_small(fq.add(a0, a1), k))
+
+    def conj(self, x: Array) -> Array:
+        return self.build(self.c0(x), self.fq.neg(self.c1(x)))
+
+    def inv(self, x: Array) -> Array:
+        # 1/(a0+a1u) = (a0 − a1u)/(a0² + a1²);  inv(0) = 0.
+        fq = self.fq
+        a0, a1 = self.c0(x), self.c1(x)
+        norm_inv = fq.inv(fq.add(fq.sq(a0), fq.sq(a1)))
+        return self.build(fq.mul(a0, norm_inv),
+                          fq.neg(fq.mul(a1, norm_inv)))
+
+    # predicates / selection -------------------------------------------------
+
+    def is_zero(self, x: Array) -> Array:
+        return self.fq.is_zero(self.c0(x)) & self.fq.is_zero(self.c1(x))
+
+    def eq(self, x: Array, y: Array) -> Array:
+        return (self.fq.eq(self.c0(x), self.c0(y)) &
+                self.fq.eq(self.c1(x), self.c1(y)))
+
+    def where(self, mask: Array, x: Array, y: Array) -> Array:
+        return jnp.where(mask[..., None, None], x, y)
+
+    def is_lex_largest(self, x: Array) -> Array:
+        """ZCash serialization sign rule for Fq2 y-coordinates: compare c1
+        first, tie-break on c0 (host analog crypto/bls12381.py
+        _y_is_lexicographically_largest_fq2)."""
+        fq = self.fq
+        half = (fq.p - 1) // 2 + 1  # y > (p−1)/2  ⇔  y ≥ (p+1)/2
+        c1_nonzero = ~fq.is_zero(self.c1(x))
+        return jnp.where(c1_nonzero,
+                         fq.geq_const(self.c1(x), half),
+                         fq.geq_const(self.c0(x), half))
+
+    # square root (branchless) ----------------------------------------------
+
+    def sqrt_checked(self, a: Array) -> Tuple[Array, Array]:
+        """(root, ok): a square root of `a` when one exists, flagged by ok.
+        Complex-sqrt method with all branches turned into selects (host
+        analog crypto/bls12381.py fq2_sqrt)."""
+        fq = self.fq
+        x, y = self.c0(a), self.c1(a)
+        inv2 = jnp.asarray(fq.from_int(pow(2, -1, fq.p)))
+
+        # Candidates for the y == 0 case: sqrt(x) or sqrt(−x)·u.
+        rx = fq.sqrt_candidate(x)
+        rx_ok = fq.eq(fq.sq(rx), x)
+        rnx = fq.sqrt_candidate(fq.neg(x))
+        rnx_ok = fq.eq(fq.sq(rnx), fq.neg(x))
+        cand_y0 = self.where(rx_ok,
+                             self.build(rx, jnp.zeros_like(rx)),
+                             self.build(jnp.zeros_like(rnx), rnx))
+        ok_y0 = rx_ok | rnx_ok
+
+        # General case: s = sqrt(x²+y²); t = sqrt((x ± s)/2); root = t + y/(2t)·u.
+        norm = fq.add(fq.sq(x), fq.sq(y))
+        s = fq.sqrt_candidate(norm)
+
+        def general(sign_s: Array) -> Tuple[Array, Array]:
+            alpha = fq.mul(fq.add(x, sign_s), inv2)
+            t = fq.sqrt_candidate(alpha)
+            # y / (2t); fq.inv(0) = 0 keeps the math total.
+            c1v = fq.mul(y, fq.inv(fq.mul_small(t, 2)))
+            cand = self.build(t, c1v)
+            return cand, self.eq(self.sq(cand), a)
+
+        cand_a, ok_a = general(s)
+        cand_b, ok_b = general(fq.neg(s))
+
+        general_cand = self.where(ok_a, cand_a, cand_b)
+        general_ok = ok_a | ok_b
+
+        y_zero = fq.is_zero(y)
+        root = self.where(y_zero, cand_y0, general_cand)
+        ok = jnp.where(y_zero, ok_y0, general_ok)
+        # Final sanity: ok implies root² == a (also covers norm non-residue).
+        ok = ok & self.eq(self.sq(root), a)
+        return root, ok

@@ -1,0 +1,153 @@
+"""Device curve ops (ops/curve.py, ops/bls12381_groups.py) vs the host
+BLS12-381 oracle (crypto/bls12381.py)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_overlord_tpu.crypto import bls12381 as oracle
+from consensus_overlord_tpu.ops.bls12381_groups import (
+    FQ, FQ2, G1, G2, ParsedG1, g1_decompress_device, g1_from_oracle,
+    g1_generator, g1_in_subgroup, g1_to_oracle, g2_decompress_device,
+    g2_from_oracle, g2_generator, g2_in_subgroup, g2_to_oracle,
+    parse_g1_compressed, parse_g2_compressed)
+from consensus_overlord_tpu.ops.curve import int_to_bits_msb
+
+RNG = random.Random(0xC17)
+
+
+def rand_g1(k):
+    return [oracle.g1_mul(oracle.G1_GEN, RNG.randrange(oracle.R))
+            for _ in range(k)]
+
+
+def rand_g2(k):
+    return [oracle.g2_mul(oracle.G2_GEN, RNG.randrange(oracle.R))
+            for _ in range(k)]
+
+
+class TestG1Add:
+    def test_add_random_and_edges(self):
+        pts_a = rand_g1(4) + [None, None, oracle.G1_GEN]
+        pts_b = rand_g1(4) + [oracle.G1_GEN, None, oracle.G1_GEN]
+        # also P + (−P)
+        p = rand_g1(1)[0]
+        pts_a.append(p)
+        pts_b.append(oracle.g1_neg(p))
+        got = g1_to_oracle(G1.add(g1_from_oracle(pts_a), g1_from_oracle(pts_b)))
+        want = [oracle.g1_add(a, b) for a, b in zip(pts_a, pts_b)]
+        assert got == want
+
+    def test_dbl(self):
+        pts = rand_g1(3) + [None]
+        got = g1_to_oracle(G1.dbl(g1_from_oracle(pts)))
+        assert got == [oracle.g1_add(p, p) for p in pts]
+
+    def test_on_curve_and_eq(self):
+        pts = rand_g1(3) + [None]
+        dev = g1_from_oracle(pts)
+        assert bool(G1.on_curve(dev).all())
+        bad = g1_from_oracle([(1, 1)])  # not on curve
+        assert not bool(G1.on_curve(bad).any())
+        assert bool(G1.eq(dev, g1_from_oracle(pts)).all())
+        neq = np.asarray(G1.eq(dev, G1.dbl(dev)))
+        assert list(neq) == [False, False, False, True]  # 2·∞ == ∞
+
+
+class TestG1ScalarMul:
+    def test_scalar_mul_bits(self):
+        ks = [0, 1, 2, oracle.R - 1] + [RNG.randrange(oracle.R)
+                                        for _ in range(4)]
+        bits = int_to_bits_msb(ks, 256)
+        got = g1_to_oracle(G1.scalar_mul_bits(g1_generator(len(ks)), bits))
+        assert got == [oracle.g1_mul(oracle.G1_GEN, k) for k in ks]
+
+    def test_scalar_mul_static_order(self):
+        pts = rand_g1(2)
+        res = G1.scalar_mul_static(g1_from_oracle(pts), oracle.R)
+        assert bool(G1.is_infinity(res).all())
+
+    def test_tree_sum(self):
+        pts = rand_g1(5)  # odd count exercises padding
+        (got,) = g1_to_oracle(G1.tree_sum(g1_from_oracle(pts)))
+        want = None
+        for p in pts:
+            want = oracle.g1_add(want, p)
+        assert got == want
+
+
+class TestG1Decompress:
+    def test_roundtrip_and_badpoints(self):
+        pts = rand_g1(4) + [None]
+        blobs = [oracle.g1_compress(p) for p in pts]
+        blobs += [b"\x00" * 48,               # compressed flag missing
+                  bytes([0xC0 | 0x20]) + b"\x00" * 47,  # bad infinity
+                  b"short"]
+        # an x not on the curve: find one deterministically
+        x = 5
+        while oracle.fq_sqrt((x**3 + 4) % oracle.P) is not None:
+            x += 1
+        blobs.append(bytes([0x80 | (x >> 376)]) + (x % (1 << 376)).to_bytes(47, "big"))
+        parsed = parse_g1_compressed(blobs)
+        pt, valid = g1_decompress_device(
+            jnp.asarray(parsed.x), jnp.asarray(parsed.sign),
+            jnp.asarray(parsed.infinity), jnp.asarray(parsed.wellformed))
+        valid = np.asarray(valid)
+        assert list(valid) == [True] * 5 + [False] * 4
+        got = g1_to_oracle(pt)
+        assert got[:5] == pts
+
+
+class TestG1Subgroup:
+    def test_subgroup_detects_cofactor_points(self):
+        # A curve point NOT in the r-subgroup: hash an x until on-curve,
+        # skip the cofactor clearing.
+        x = 2
+        while True:
+            y = oracle.fq_sqrt((x**3 + 4) % oracle.P)
+            if y is not None and not oracle.g1_in_subgroup((x, y)):
+                break
+            x += 1
+        good = rand_g1(2)
+        batch = g1_from_oracle(good + [(x, y), None])
+        got = list(np.asarray(g1_in_subgroup(batch)))
+        assert got == [True, True, False, True]
+
+
+class TestG2:
+    def test_add_mul_vs_oracle(self):
+        pts = rand_g2(2) + [None]
+        ks = [3, RNG.randrange(oracle.R), 7]
+        dev = g2_from_oracle(pts)
+        got = g2_to_oracle(G2.add(dev, dev))
+        assert got == [oracle.g2_add(p, p) for p in pts]
+        bits = int_to_bits_msb(ks, 256)
+        got = g2_to_oracle(G2.scalar_mul_bits(dev, bits))
+        assert got == [oracle.g2_mul(p, k) for p, k in zip(pts, ks)]
+
+    def test_on_curve(self):
+        dev = g2_from_oracle(rand_g2(2) + [None])
+        assert bool(G2.on_curve(dev).all())
+
+    def test_decompress_roundtrip(self):
+        pts = rand_g2(3) + [None]
+        blobs = [oracle.g2_compress(p) for p in pts] + [b"\x00" * 96]
+        parsed = parse_g2_compressed(blobs)
+        pt, valid = g2_decompress_device(
+            jnp.asarray(parsed.x), jnp.asarray(parsed.sign),
+            jnp.asarray(parsed.infinity), jnp.asarray(parsed.wellformed))
+        assert list(np.asarray(valid)) == [True] * 4 + [False]
+        assert g2_to_oracle(pt)[:4] == pts
+
+    def test_subgroup(self):
+        dev = g2_from_oracle(rand_g2(2) + [None])
+        assert list(np.asarray(g2_in_subgroup(dev))) == [True, True, True]
+
+    def test_tree_sum(self):
+        pts = rand_g2(3)
+        (got,) = g2_to_oracle(G2.tree_sum(g2_from_oracle(pts)))
+        want = None
+        for p in pts:
+            want = oracle.g2_add(want, p)
+        assert got == want
