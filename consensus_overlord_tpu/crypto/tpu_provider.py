@@ -1,0 +1,379 @@
+"""TpuBlsCrypto: the device-batched BLS12-381 crypto provider.
+
+This is the component the reference could never have — its provider
+(ophelia-blst → native blst, reference src/consensus.rs:336-337) verifies
+one signature at a time on the CPU (src/consensus.rs:397-416) and loops
+pair-by-pair to aggregate (src/consensus.rs:418-443).  Here the O(N) work
+of a consensus round — N vote verifies at the leader, N pubkey
+aggregations per QC check — is batched across TPU lanes:
+
+* ``verify_batch``: random-linear-combination batch verification.  For
+  signatures S_i on a common message hash H by pubkeys P_i, draw random
+  128-bit r_i and check one relation
+      e(Σ r_i·S_i, −g2) · e(H, Σ r_i·P_i) == 1
+  The two multi-scalar-multiplications (the O(N) part) run on device as
+  uniform double-and-add scans + a log₂(N) tree reduction; the two
+  pairings (O(1)) run on the host oracle.  Distinct messages group into
+  one extra pairing per distinct hash.  A failed batch falls back to
+  per-signature verification, so results are exact, not probabilistic.
+
+* ``aggregate_signatures`` / ``verify_aggregated_signature``: the QC
+  hot path (reference src/consensus.rs:418-462) — device tree-sum over
+  decompressed points for large N, host oracle below a crossover size.
+
+Host↔device traffic is one transfer of packed int32 limb arrays each way
+per batch — sized for a high-latency PJRT link where each dispatch is
+expensive (SURVEY.md §7 hard part (c)).
+
+Signing keys stay host-side (SURVEY.md §7 hard part (e)).
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sm3 import sm3_hash
+from ..ops import bls12381_groups as dev
+from ..ops.curve import Point
+from . import bls12381 as oracle
+from .provider import CpuBlsCrypto, CryptoError
+
+# Batches are padded to the next size in this ladder so the number of
+# distinct jit specializations stays small.
+_PAD_SIZES = (8, 32, 128, 512, 2048, 8192)
+_SCALAR_BITS = 128
+
+
+def _pad_to(n: int) -> int:
+    for s in _PAD_SIZES:
+        if n <= s:
+            return s
+    return -(-n // _PAD_SIZES[-1]) * _PAD_SIZES[-1]
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (module-level so jax.jit caches by shape).
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _g1_validate_msm(x, sign, inf, ok, bits):
+    """Decompress+validate a batch of G1 signatures and reduce Σ r_i·S_i.
+    Returns (affine x, affine y, agg-is-infinity, per-lane valid)."""
+    pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
+    valid = valid & ~inf
+    valid = valid & dev.g1_in_subgroup(pt)
+    pt = dev.G1.select(valid, pt, dev.G1.infinity_like(x))
+    agg = dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits))
+    ax, ay, ainf = dev.G1.to_affine(agg)
+    return ax[0], ay[0], ainf[0], valid
+
+
+@jax.jit
+def _g2_validate(x, sign, inf, ok):
+    """Decompress + subgroup-check a batch of G2 public keys.  Returns
+    projective coords + validity (used to fill the pubkey cache)."""
+    pt, valid = dev.g2_decompress_device(x, sign, inf, ok)
+    valid = valid & ~inf
+    valid = valid & dev.g2_in_subgroup(pt)
+    return pt.x, pt.y, pt.z, valid
+
+
+@jax.jit
+def _g2_msm(px, py, pz, bits):
+    """Σ r_i·P_i over pre-validated G2 points; affine result."""
+    agg = dev.G2.tree_sum(dev.G2.scalar_mul_bits(Point(px, py, pz), bits))
+    ax, ay, ainf = dev.G2.to_affine(agg)
+    return ax[0], ay[0], ainf[0]
+
+
+@jax.jit
+def _g1_validate_sum(x, sign, inf, ok):
+    """Decompress a batch of G1 signatures and tree-sum them (the
+    aggregation of reference src/consensus.rs:418-444).  No subgroup check,
+    matching the oracle aggregate path."""
+    pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
+    agg = dev.G1.tree_sum(
+        dev.G1.select(valid & ~inf, pt, dev.G1.infinity_like(x)))
+    ax, ay, ainf = dev.G1.to_affine(agg)
+    return ax[0], ay[0], ainf[0], valid
+
+
+@jax.jit
+def _g2_sum(px, py, pz):
+    """Σ P_i over pre-validated G2 points (QC pubkey aggregation,
+    reference src/consensus.rs:365-383)."""
+    agg = dev.G2.tree_sum(Point(px, py, pz))
+    ax, ay, ainf = dev.G2.to_affine(agg)
+    return ax[0], ay[0], ainf[0]
+
+
+def _affine_to_oracle_g1(ax, ay, ainf) -> Optional[Tuple[int, int]]:
+    if bool(ainf):
+        return None
+    (xv,) = dev.FQ.to_ints(ax)
+    (yv,) = dev.FQ.to_ints(ay)
+    return (xv, yv)
+
+
+def _affine_to_oracle_g2(ax, ay, ainf):
+    if bool(ainf):
+        return None
+    (xp,) = dev.FQ2.to_int_pairs(ax)
+    (yp,) = dev.FQ2.to_int_pairs(ay)
+    return (xp, yp)
+
+
+class TpuBlsCrypto:
+    """CryptoProvider (reference Overlord `Crypto` trait surface,
+    src/consensus.rs:385-463) with device-batched verification paths.
+
+    `device_threshold`: below this batch size the host oracle is cheaper
+    than a device round-trip (the PJRT link costs ~100 ms per dispatch);
+    at or above it, work ships to the TPU.
+    """
+
+    def __init__(self, private_key: int, common_ref: bytes = b"",
+                 device_threshold: int = 32):
+        self._cpu = CpuBlsCrypto(private_key, common_ref)
+        self._common_ref = common_ref
+        self._threshold = device_threshold
+        # voter bytes → (device row arrays, oracle affine point) for
+        # validated pubkeys; None for known-bad keys.
+        self._pk_cache: Dict[bytes, Optional[Tuple[np.ndarray, np.ndarray,
+                                                   np.ndarray, tuple]]] = {}
+
+    # -- provider surface ----------------------------------------------------
+
+    @property
+    def pub_key(self) -> bytes:
+        return self._cpu.pub_key
+
+    def hash(self, data: bytes) -> bytes:
+        return sm3_hash(data)
+
+    def sign(self, hash32: bytes) -> bytes:
+        return self._cpu.sign(hash32)
+
+    def verify_signature(self, signature: bytes, hash32: bytes,
+                         voter: bytes) -> bool:
+        return self._cpu.verify_signature(signature, hash32, voter)
+
+    def aggregate_signatures(self, signatures: Sequence[bytes],
+                             voters: Sequence[bytes]) -> bytes:
+        if len(signatures) != len(voters):
+            raise CryptoError(
+                f"signatures x voters length mismatch "
+                f"{len(signatures)} x {len(voters)}")
+        if len(signatures) < self._threshold:
+            return self._cpu.aggregate_signatures(signatures, voters)
+        n = len(signatures)
+        size = _pad_to(n)
+        parsed = dev.parse_g1_compressed(list(signatures))
+        x = np.zeros((size, dev.FQ.n), np.int32)
+        x[:n] = parsed.x
+        sign_f = np.zeros(size, bool)
+        sign_f[:n] = parsed.sign
+        inf = np.zeros(size, bool)
+        inf[:n] = parsed.infinity
+        ok = np.zeros(size, bool)
+        ok[:n] = parsed.wellformed
+        ax, ay, ainf, valid = _g1_validate_sum(
+            jnp.asarray(x), jnp.asarray(sign_f), jnp.asarray(inf),
+            jnp.asarray(ok))
+        if not bool(np.asarray(valid)[:n].all()):
+            raise CryptoError("invalid signature in aggregation batch")
+        return oracle.g1_compress(_affine_to_oracle_g1(ax, ay, ainf))
+
+    def verify_aggregated_signature(self, agg_sig: bytes, hash32: bytes,
+                                    voters: Sequence[bytes]) -> bool:
+        if len(voters) < self._threshold:
+            return self._cpu.verify_aggregated_signature(
+                agg_sig, hash32, voters)
+        rows = self._pubkey_rows(voters)
+        if rows is None:
+            return False
+        px, py, pz = rows
+        agg_pk = _affine_to_oracle_g2(*_g2_sum(
+            jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz)))
+        if agg_pk is None:
+            return False
+        try:
+            sig_pt = oracle.g1_decompress(agg_sig)
+        except ValueError:
+            return False
+        if sig_pt is None or not oracle.g1_in_subgroup(sig_pt):
+            return False
+        h = oracle.hash_to_g1(hash32, self._common_ref)
+        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+        return oracle.multi_pairing_is_one([(sig_pt, neg_g2), (h, agg_pk)])
+
+    # -- batched verification ------------------------------------------------
+
+    def verify_batch(self, signatures: Sequence[bytes],
+                     hashes: Sequence[bytes],
+                     voters: Sequence[bytes]) -> List[bool]:
+        """Exact batched verification of (sig_i, hash_i, voter_i) triples.
+        The common case — many votes on one hash — costs two device MSMs
+        plus 1 + #distinct-hashes host pairings; a failed batch relation
+        falls back to per-signature checks to localize the bad lanes."""
+        n = len(signatures)
+        assert len(hashes) == n and len(voters) == n
+        if n == 0:
+            return []
+        if n < self._threshold:
+            return [self._cpu.verify_signature(s, h, v)
+                    for s, h, v in zip(signatures, hashes, voters)]
+
+        # Pubkeys: validate (cached) and gather device rows.
+        self._ensure_pubkeys(voters)
+        pk_ok = np.array(
+            [self._pk_cache[bytes(v)] is not None for v in voters], bool)
+
+        size = _pad_to(n)
+        parsed = dev.parse_g1_compressed(list(signatures))
+        sx = np.zeros((size, dev.FQ.n), np.int32)
+        sx[:n] = parsed.x
+        ssign = np.zeros(size, bool)
+        ssign[:n] = parsed.sign
+        sinf = np.zeros(size, bool)
+        sinf[:n] = parsed.infinity
+        sok = np.zeros(size, bool)
+        # lanes with bad pubkeys are disabled entirely
+        sok[:n] = parsed.wellformed & pk_ok
+
+        # Random 128-bit scalars (nonzero); padding lanes get scalar 0.
+        scalars = [
+            (1 << (_SCALAR_BITS - 1)) | secrets.randbits(_SCALAR_BITS - 1)
+            for _ in range(n)]
+        bits = np.zeros((size, _SCALAR_BITS), np.int32)
+        for i, r in enumerate(scalars):
+            for j in range(_SCALAR_BITS):
+                bits[i, _SCALAR_BITS - 1 - j] = (r >> j) & 1
+
+        ax, ay, ainf, valid = _g1_validate_msm(
+            jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+            jnp.asarray(sok), jnp.asarray(bits))
+        valid = np.asarray(valid)[:n] & pk_ok
+        agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
+
+        # Group lanes by message hash: one G2 MSM + one pairing per group.
+        groups: Dict[bytes, List[int]] = {}
+        for i, h in enumerate(hashes):
+            if valid[i]:
+                groups.setdefault(bytes(h), []).append(i)
+        if not groups:
+            return [False] * n
+
+        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+        pairs = [(agg_sig, neg_g2)]
+        for h, idxs in groups.items():
+            gsize = _pad_to(len(idxs))
+            px = np.zeros((gsize, 2, dev.FQ.n), np.int32)
+            py = np.zeros((gsize, 2, dev.FQ.n), np.int32)
+            pz = np.zeros((gsize, 2, dev.FQ.n), np.int32)
+            gbits = np.zeros((gsize, _SCALAR_BITS), np.int32)
+            for j, i in enumerate(idxs):
+                rx, ry, rz, _aff = self._pk_cache[bytes(voters[i])]
+                px[j], py[j], pz[j] = rx, ry, rz
+                gbits[j] = bits[i]
+            agg_pk = _affine_to_oracle_g2(*_g2_msm(
+                jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz),
+                jnp.asarray(gbits)))
+            h_pt = oracle.hash_to_g1(h, self._common_ref)
+            pairs.append((h_pt, agg_pk))
+
+        if oracle.multi_pairing_is_one(pairs):
+            return list(valid)
+        # Batch relation failed: localize with exact per-lane checks.
+        return [bool(valid[i]) and self._verify_one_cached(
+                    signatures[i], hashes[i], voters[i])
+                for i in range(n)]
+
+    # -- internals -----------------------------------------------------------
+
+    def _verify_one_cached(self, sig: bytes, hash32: bytes,
+                           voter: bytes) -> bool:
+        entry = self._pk_cache.get(bytes(voter))
+        if entry is None:
+            return False
+        _, _, _, pk_aff = entry
+        try:
+            sig_pt = oracle.g1_decompress(sig)
+        except ValueError:
+            return False
+        if sig_pt is None or not oracle.g1_in_subgroup(sig_pt):
+            return False
+        h = oracle.hash_to_g1(hash32, self._common_ref)
+        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+        return oracle.multi_pairing_is_one([(sig_pt, neg_g2), (h, pk_aff)])
+
+    def _ensure_pubkeys(self, voters: Sequence[bytes]) -> None:
+        missing = []
+        seen = set()
+        for v in voters:
+            vb = bytes(v)
+            if vb not in self._pk_cache and vb not in seen:
+                seen.add(vb)
+                missing.append(vb)
+        if not missing:
+            return
+        self.update_pubkeys(missing)
+
+    def update_pubkeys(self, voters: Sequence[bytes]) -> None:
+        """Validate and cache a validator set's public keys — the analog of
+        the reference's pubkey cache refresh on reconfigure/commit
+        (src/consensus.rs:131-136, 622-629), where a bad key is surfaced
+        per-key instead of panicking."""
+        voters = [bytes(v) for v in voters]
+        n = len(voters)
+        if n == 0:
+            return
+        size = _pad_to(n)
+        parsed = dev.parse_g2_compressed(voters)
+        x = np.zeros((size, 2, dev.FQ.n), np.int32)
+        x[:n] = parsed.x
+        sgn = np.zeros(size, bool)
+        sgn[:n] = parsed.sign
+        inf = np.zeros(size, bool)
+        inf[:n] = parsed.infinity
+        ok = np.zeros(size, bool)
+        ok[:n] = parsed.wellformed
+        px, py, pz, valid = _g2_validate(
+            jnp.asarray(x), jnp.asarray(sgn), jnp.asarray(inf),
+            jnp.asarray(ok))
+        px, py, pz = np.asarray(px), np.asarray(py), np.asarray(pz)
+        valid = np.asarray(valid)
+        aff = dev.g2_to_oracle(Point(jnp.asarray(px[:n]), jnp.asarray(py[:n]),
+                                     jnp.asarray(pz[:n])))
+        for i, v in enumerate(voters):
+            if valid[i]:
+                self._pk_cache[v] = (px[i], py[i], pz[i], aff[i])
+            else:
+                self._pk_cache[v] = None
+
+    def _pubkey_rows(self, voters: Sequence[bytes]):
+        """Gathered, padded device rows for a voter list; None if any
+        voter's key is invalid (an aggregated QC over a bad key can never
+        verify)."""
+        self._ensure_pubkeys(voters)
+        n = len(voters)
+        size = _pad_to(n)
+        px = np.zeros((size, 2, dev.FQ.n), np.int32)
+        py = np.zeros((size, 2, dev.FQ.n), np.int32)
+        pz = np.zeros((size, 2, dev.FQ.n), np.int32)
+        for i, v in enumerate(voters):
+            entry = self._pk_cache[bytes(v)]
+            if entry is None:
+                return None
+            px[i], py[i], pz[i] = entry[0], entry[1], entry[2]
+        # padding lanes: projective identity (0:1:0)
+        one2 = np.zeros((2, dev.FQ.n), np.int32)
+        one2[0] = dev.FQ.from_int(1)
+        for j in range(n, size):
+            py[j] = one2
+        return px, py, pz

@@ -175,15 +175,35 @@ def g2_in_subgroup(p: Point) -> Array:
 # Host conversions for cross-checking with the oracle.
 # ---------------------------------------------------------------------------
 
+_g1_to_affine_jit = None
+_g2_to_affine_jit = None
+
+
+def _affine_g1(p: Point):
+    global _g1_to_affine_jit
+    if _g1_to_affine_jit is None:
+        import jax
+        _g1_to_affine_jit = jax.jit(G1.to_affine)
+    return _g1_to_affine_jit(p)
+
+
+def _affine_g2(p: Point):
+    global _g2_to_affine_jit
+    if _g2_to_affine_jit is None:
+        import jax
+        _g2_to_affine_jit = jax.jit(G2.to_affine)
+    return _g2_to_affine_jit(p)
+
+
 def g1_to_oracle(p: Point) -> List:
-    x, y, inf = G1.to_affine(p)
+    x, y, inf = _affine_g1(p)
     xs, ys = FQ.to_ints(x), FQ.to_ints(y)
     infs = np.asarray(inf).reshape(-1)
     return [None if i else (xv, yv) for xv, yv, i in zip(xs, ys, infs)]
 
 
 def g2_to_oracle(p: Point) -> List:
-    x, y, inf = G2.to_affine(p)
+    x, y, inf = _affine_g2(p)
     xs, ys = FQ2.to_int_pairs(x), FQ2.to_int_pairs(y)
     infs = np.asarray(inf).reshape(-1)
     return [None if i else (xv, yv) for xv, yv, i in zip(xs, ys, infs)]

@@ -108,6 +108,10 @@ class FieldSpec:
 
         self._one_np = np.array(_digits(1, b, n), dtype=np.int64)
 
+        # strict() is the eager-path workhorse (scan + cond-sub ladder);
+        # jit it once so host-side canonicalization is one dispatch.
+        self._strict_jit = jax.jit(self._strict_impl)
+
         # Dry-run the mul/add/sub reduction plans once so an unreducible
         # layout fails at spec construction, not first trace.
         for bounds in (self._conv_bounds(),
@@ -281,6 +285,11 @@ class FieldSpec:
     def strict(self, x: Array) -> Array:
         """Canonical strict digits of x mod p (each < 2**b, value < p).
         Input must be loose (limbs ≤ loose_max)."""
+        if isinstance(x, jax.core.Tracer):
+            return self._strict_impl(x)  # already inside a jit/vmap trace
+        return self._strict_jit(x)
+
+    def _strict_impl(self, x: Array) -> Array:
         over = self._fold[0]  # 2**(b·n) mod p
         for _ in range(2):
             x, c = self._scan_carry(x)

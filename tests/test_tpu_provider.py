@@ -1,0 +1,117 @@
+"""TpuBlsCrypto (device-batched provider) against the CPU oracle provider.
+
+device_threshold=1 forces every path through the device kernels even at
+test-sized batches (they pad to 8 lanes)."""
+
+import pytest
+
+from consensus_overlord_tpu.crypto.provider import CpuBlsCrypto, CryptoError
+from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+from consensus_overlord_tpu.core.sm3 import sm3_hash
+
+N = 6
+KEYS = [0x1111 * (i + 1) + 7 for i in range(N)]
+
+
+@pytest.fixture(scope="module")
+def cpus():
+    return [CpuBlsCrypto(k) for k in KEYS]
+
+
+@pytest.fixture(scope="module")
+def tpu(cpus):
+    t = TpuBlsCrypto(KEYS[0], device_threshold=1)
+    t.update_pubkeys([c.pub_key for c in cpus])
+    return t
+
+
+def make_votes(cpus, msg=b"block-hash-1"):
+    h = sm3_hash(msg)
+    return ([c.sign(h) for c in cpus], [h] * len(cpus),
+            [c.pub_key for c in cpus])
+
+
+class TestVerifyBatch:
+    def test_all_valid(self, cpus, tpu):
+        sigs, hashes, voters = make_votes(cpus)
+        assert tpu.verify_batch(sigs, hashes, voters) == [True] * N
+
+    def test_mixed_messages(self, cpus, tpu):
+        h1, h2 = sm3_hash(b"m1"), sm3_hash(b"m2")
+        sigs = [c.sign(h1) for c in cpus[:3]] + [c.sign(h2) for c in cpus[3:]]
+        hashes = [h1] * 3 + [h2] * (N - 3)
+        voters = [c.pub_key for c in cpus]
+        assert tpu.verify_batch(sigs, hashes, voters) == [True] * N
+
+    def test_bad_lane_localized(self, cpus, tpu):
+        sigs, hashes, voters = make_votes(cpus)
+        sigs[2] = cpus[2].sign(sm3_hash(b"other"))  # valid point, wrong msg
+        got = tpu.verify_batch(sigs, hashes, voters)
+        assert got == [True, True, False, True, True, True]
+
+    def test_malformed_sig_and_bad_voter(self, cpus, tpu):
+        sigs, hashes, voters = make_votes(cpus)
+        sigs[0] = b"\x00" * 48           # compressed flag missing
+        sigs[1] = b"short"
+        voters[3] = b"\x01" * 96         # not a valid pubkey encoding
+        got = tpu.verify_batch(sigs, hashes, voters)
+        assert got == [False, False, True, False, True, True]
+
+    def test_wrong_signer(self, cpus, tpu):
+        sigs, hashes, voters = make_votes(cpus)
+        sigs[4], sigs[5] = sigs[5], sigs[4]  # swapped: wrong keys
+        got = tpu.verify_batch(sigs, hashes, voters)
+        assert got == [True, True, True, True, False, False]
+
+    def test_matches_cpu_provider(self, cpus, tpu):
+        sigs, hashes, voters = make_votes(cpus, b"cross-check")
+        want = [cpus[0].verify_signature(s, h, v)
+                for s, h, v in zip(sigs, hashes, voters)]
+        assert tpu.verify_batch(sigs, hashes, voters) == want
+
+
+class TestAggregate:
+    def test_aggregate_matches_oracle(self, cpus, tpu):
+        h = sm3_hash(b"agg")
+        sigs = [c.sign(h) for c in cpus]
+        voters = [c.pub_key for c in cpus]
+        assert (tpu.aggregate_signatures(sigs, voters) ==
+                cpus[0].aggregate_signatures(sigs, voters))
+
+    def test_aggregate_rejects_garbage(self, cpus, tpu):
+        h = sm3_hash(b"agg")
+        sigs = [c.sign(h) for c in cpus]
+        sigs[1] = b"\xff" * 48
+        with pytest.raises(CryptoError):
+            tpu.aggregate_signatures(sigs, [c.pub_key for c in cpus])
+
+    def test_length_mismatch(self, tpu, cpus):
+        with pytest.raises(CryptoError):
+            tpu.aggregate_signatures([b"x"], [c.pub_key for c in cpus])
+
+    def test_verify_aggregated(self, cpus, tpu):
+        h = sm3_hash(b"qc")
+        voters = [c.pub_key for c in cpus]
+        agg = cpus[0].aggregate_signatures([c.sign(h) for c in cpus], voters)
+        assert tpu.verify_aggregated_signature(agg, h, voters)
+        assert not tpu.verify_aggregated_signature(agg, sm3_hash(b"no"), voters)
+        # subset of voters ⇒ aggregate over full set must fail
+        assert not tpu.verify_aggregated_signature(agg, h, voters[:4])
+
+    def test_verify_aggregated_bad_voter(self, cpus, tpu):
+        h = sm3_hash(b"qc2")
+        voters = [c.pub_key for c in cpus]
+        agg = cpus[0].aggregate_signatures([c.sign(h) for c in cpus], voters)
+        bad_voters = voters[:-1] + [b"\x02" * 96]
+        assert not tpu.verify_aggregated_signature(agg, h, bad_voters)
+
+
+class TestProviderSurface:
+    def test_sign_verify_roundtrip(self, tpu):
+        h = sm3_hash(b"single")
+        sig = tpu.sign(h)
+        assert tpu.verify_signature(sig, h, tpu.pub_key)
+        assert not tpu.verify_signature(sig, sm3_hash(b"x"), tpu.pub_key)
+
+    def test_hash_is_sm3(self, tpu):
+        assert tpu.hash(b"abc") == sm3_hash(b"abc")
