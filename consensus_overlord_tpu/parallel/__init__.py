@@ -1,0 +1,15 @@
+"""Device-mesh parallelism for the crypto hot path.
+
+The reference's distributed axis is N validator processes exchanging BFT
+messages (SURVEY.md §2.3); its per-node crypto is sequential native code.
+Here the per-node crypto is data-parallel across a `jax.sharding.Mesh`:
+signature lanes shard over the mesh axis, each device validates and
+locally reduces its lanes, and the partial group sums combine with an
+`all_gather` ride over ICI — O(N/D) point work per device, O(D) combine.
+
+This is the DP analog named in SURVEY.md §2.3; sharding one MSM's point
+range across devices plays the role tensor parallelism plays in ML stacks.
+"""
+
+from .sharded import (  # noqa: F401
+    make_mesh, sharded_g1_verify_msm, sharded_g2_msm, sharded_round_step)

@@ -1,0 +1,77 @@
+"""Sharded crypto step over the virtual 8-device CPU mesh vs the oracle."""
+
+import random
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from consensus_overlord_tpu.crypto import bls12381 as oracle
+from consensus_overlord_tpu.ops import bls12381_groups as dev
+from consensus_overlord_tpu.ops.curve import int_to_bits_msb
+from consensus_overlord_tpu.parallel import (
+    make_mesh, sharded_g1_verify_msm, sharded_round_step)
+
+RNG = random.Random(0x5A)
+B = 16
+NBITS = 32  # short scalars keep the test compile cheap; shape-generic code
+
+
+@pytest.fixture(scope="module")
+def fixture_data():
+    sks = [RNG.randrange(2, oracle.R) for _ in range(B)]
+    msg = b"round-msg"
+    sigs = [oracle.sign(sk, msg) for sk in sks]
+    pks = [oracle.sk_to_pk(sk) for sk in sks]
+    scalars = [RNG.randrange(1, 1 << NBITS) for _ in range(B)]
+    return msg, sigs, pks, scalars
+
+
+def test_sharded_g1_msm_matches_oracle(fixture_data):
+    msg, sigs, pks, scalars = fixture_data
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh(8)
+    fn = sharded_g1_verify_msm(mesh)
+    parsed = dev.parse_g1_compressed(sigs)
+    bits = int_to_bits_msb(scalars, NBITS)
+    ax, ay, ainf, valid = fn(
+        jnp.asarray(parsed.x), jnp.asarray(parsed.sign),
+        jnp.asarray(parsed.infinity), jnp.asarray(parsed.wellformed),
+        bits)
+    assert list(np.asarray(valid)) == [True] * B
+    want = None
+    for s, r in zip(sigs, scalars):
+        want = oracle.g1_add(want, oracle.g1_mul(oracle.g1_decompress(s), r))
+    got = (dev.FQ.to_ints(ax)[0], dev.FQ.to_ints(ay)[0])
+    assert got == want
+
+
+def test_sharded_round_step_runs_and_aggregates(fixture_data):
+    msg, sigs, pks, scalars = fixture_data
+    mesh = make_mesh(8)
+    step = sharded_round_step(mesh)
+    parsed = dev.parse_g1_compressed(sigs)
+    pk_parsed = dev.parse_g2_compressed(pks)
+    pk_pt, pk_ok = dev.g2_decompress_device(
+        jnp.asarray(pk_parsed.x), jnp.asarray(pk_parsed.sign),
+        jnp.asarray(pk_parsed.infinity), jnp.asarray(pk_parsed.wellformed))
+    assert bool(np.asarray(pk_ok).all())
+    bits = int_to_bits_msb(scalars, NBITS)
+    out = step(jnp.asarray(parsed.x), jnp.asarray(parsed.sign),
+               jnp.asarray(parsed.infinity), jnp.asarray(parsed.wellformed),
+               pk_pt.x, pk_pt.y, pk_pt.z, bits)
+    (ax1, ay1, ai1, ax2, ay2, ai2, ax3, ay3, ai3, valid) = out
+    assert list(np.asarray(valid)) == [True] * B
+    # QC aggregate (unit weights) must equal the oracle signature sum.
+    want = None
+    for s in sigs:
+        want = oracle.g1_add(want, oracle.g1_decompress(s))
+    assert (dev.FQ.to_ints(ax3)[0], dev.FQ.to_ints(ay3)[0]) == want
+    # G2 RLC must equal Σ r_i·P_i.
+    want2 = None
+    for p, r in zip(pks, scalars):
+        want2 = oracle.g2_add(want2, oracle.g2_mul(oracle.g2_decompress(p), r))
+    (x_pair,) = dev.FQ2.to_int_pairs(ax2)
+    (y_pair,) = dev.FQ2.to_int_pairs(ay2)
+    assert (x_pair, y_pair) == want2
