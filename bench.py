@@ -1,0 +1,98 @@
+"""Benchmark: BLS12-381 signature verification throughput per chip.
+
+Measures the end-to-end batched vote-verification path — the hot loop of a
+consensus round (reference src/consensus.rs:397-416 does this one
+signature at a time in native CPU code):
+
+  host parse → device decompress+subgroup+RLC-MSM (G1 over signatures,
+  G2 over cached pubkeys) → host pairing check (2 pairings, O(1)).
+
+Baseline = the host CPU oracle verifying one signature at a time
+(the single-thread blst-equivalent posture of BASELINE.md config 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N = int(os.environ.get("BENCH_N", "1024"))       # votes per round-batch
+ITERS = int(os.environ.get("BENCH_ITERS", "4"))  # timed iterations
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_fixture.npz")
+
+
+def _fixture():
+    """N (sig, pubkey) pairs on one message hash; disk-cached because host
+    signing is the slow part of setup, not the thing under test."""
+    import numpy as np
+
+    from consensus_overlord_tpu.core.sm3 import sm3_hash
+    from consensus_overlord_tpu.crypto import bls12381 as oracle
+
+    h = sm3_hash(b"bench-block-hash")
+    if os.path.exists(CACHE):
+        data = np.load(CACHE)
+        if data["sigs"].shape[0] == N:
+            sigs = [bytes(r) for r in data["sigs"]]
+            pks = [bytes(r) for r in data["pks"]]
+            return sigs, h, pks
+    sks = [0xBEEF + 97 * i for i in range(N)]
+    sigs = [oracle.sign(sk, h) for sk in sks]
+    pks = [oracle.sk_to_pk(sk) for sk in sks]
+    np.savez(CACHE,
+             sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N, 48),
+             pks=np.frombuffer(b"".join(pks), np.uint8).reshape(N, 96))
+    return sigs, h, pks
+
+
+def main():
+    # Persistent compilation cache: the big kernels compile once per
+    # machine, not once per bench run.
+    import jax
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from consensus_overlord_tpu.crypto import bls12381 as oracle
+    from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+
+    sigs, h, pks = _fixture()
+
+    provider = TpuBlsCrypto(0xA11CE)
+    provider.update_pubkeys(pks)          # per-reconfigure cost, not per-round
+    hashes = [h] * N
+
+    # Warmup: compile + one correctness pass.
+    result = provider.verify_batch(sigs, hashes, pks)
+    assert all(result), "bench batch failed verification"
+
+    t0 = time.time()
+    for _ in range(ITERS):
+        result = provider.verify_batch(sigs, hashes, pks)
+    elapsed = time.time() - t0
+    rate = N * ITERS / elapsed
+
+    # Baseline: host oracle, one signature at a time (single-thread CPU).
+    k = 8
+    t0 = time.time()
+    for i in range(k):
+        assert oracle.verify(pks[i], h, sigs[i])
+    cpu_rate = k / (time.time() - t0)
+
+    print(json.dumps({
+        "metric": "bls12381_sig_verifies_per_sec_per_chip",
+        "value": round(rate, 2),
+        "unit": "verifies/s",
+        "vs_baseline": round(rate / cpu_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -60,10 +60,10 @@ def _pad_to(n: int) -> int:
 # Device kernels (module-level so jax.jit caches by shape).
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def _g1_validate_msm(x, sign, inf, ok, bits):
+def g1_validate_msm_fn(x, sign, inf, ok, bits):
     """Decompress+validate a batch of G1 signatures and reduce Σ r_i·S_i.
-    Returns (affine x, affine y, agg-is-infinity, per-lane valid)."""
+    Returns (affine x, affine y, agg-is-infinity, per-lane valid).
+    Un-jitted core — the single-chip flagship forward step."""
     pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
     valid = valid & ~inf
     valid = valid & dev.g1_in_subgroup(pt)
@@ -71,6 +71,9 @@ def _g1_validate_msm(x, sign, inf, ok, bits):
     agg = dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits))
     ax, ay, ainf = dev.G1.to_affine(agg)
     return ax[0], ay[0], ainf[0], valid
+
+
+_g1_validate_msm = jax.jit(g1_validate_msm_fn)
 
 
 @jax.jit
