@@ -1,0 +1,129 @@
+"""The batching frontier: coalesce concurrent signature verifications into
+device-sized batches.
+
+The reference verifies each inbound vote synchronously inside the engine's
+message loop, one native blst call at a time (src/consensus.rs:397-416).
+On TPU a single verification can't pay for a device dispatch — but a
+consensus round delivers N votes near-simultaneously.  The frontier sits
+at the inbound-network edge (the proc_network_msg path,
+src/consensus.rs:210-262): each message's signature check becomes an
+awaitable; requests that arrive within one linger window (or up to a max
+batch) flush together through the provider's ``verify_batch`` — which for
+TpuBlsCrypto is two MSMs on device + O(1) host pairings (SURVEY.md §7
+"batching frontier" / hard part (c)).
+
+Messages whose signatures fail are dropped at the frontier (the engine
+then runs with ``inbound_verified=True`` and skips per-message verifies);
+malformed input degrades to a False result, never an exception — the
+log-and-drop posture of src/consensus.rs:220-260.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..core.sm3 import sm3_hash
+from ..core.types import SignedChoke, SignedProposal, SignedVote
+
+logger = logging.getLogger("consensus_overlord_tpu.frontier")
+
+
+def signature_claims(msg) -> Optional[Tuple[bytes, bytes, bytes]]:
+    """(signature, hash32, voter) claimed by an inbound consensus message,
+    or None for message types verified elsewhere (QCs carry aggregated
+    signatures checked in the engine against the voter bitmap)."""
+    if isinstance(msg, SignedProposal):
+        return (msg.signature, sm3_hash(msg.proposal.encode()),
+                msg.proposal.proposer)
+    if isinstance(msg, SignedVote):
+        return msg.signature, sm3_hash(msg.vote.encode()), msg.voter
+    if isinstance(msg, SignedChoke):
+        return msg.signature, sm3_hash(msg.choke.encode()), msg.address
+    return None
+
+
+@dataclass
+class FrontierStats:
+    requests: int = 0
+    batches: int = 0
+    max_batch: int = 0
+    failures: int = 0
+
+    @property
+    def mean_batch(self) -> float:
+        return self.requests / self.batches if self.batches else 0.0
+
+
+class BatchingVerifier:
+    """Coalesces `verify(sig, hash, voter)` awaitables into provider
+    `verify_batch` calls.
+
+    linger_s: how long the first request of a batch waits for company.
+    max_batch: flush immediately at this size (matches the provider's
+    padded batch ladder so device kernels stay shape-stable).
+    """
+
+    def __init__(self, provider, max_batch: int = 1024,
+                 linger_s: float = 0.002):
+        self._provider = provider
+        self._max_batch = max_batch
+        self._linger = linger_s
+        self._pending: List[Tuple[bytes, bytes, bytes, asyncio.Future]] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self.stats = FrontierStats()
+
+    async def verify(self, signature: bytes, hash32: bytes,
+                     voter: bytes) -> bool:
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((bytes(signature), bytes(hash32), bytes(voter),
+                              fut))
+        self.stats.requests += 1
+        if len(self._pending) >= self._max_batch:
+            self._flush_now()
+        elif self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._linger_then_flush())
+        return await fut
+
+    async def verify_msg(self, msg) -> bool:
+        """Verify a decoded consensus message's signature claim; True for
+        message types with no frontier-checkable signature."""
+        claims = signature_claims(msg)
+        if claims is None:
+            return True
+        return await self.verify(*claims)
+
+    async def _linger_then_flush(self) -> None:
+        await asyncio.sleep(self._linger)
+        self._flush_now()
+
+    def _flush_now(self) -> None:
+        batch, self._pending = self._pending, []
+        if not batch:
+            return
+        if self._flush_task is not None and not self._flush_task.done():
+            self._flush_task.cancel()
+        self._flush_task = None
+        asyncio.get_running_loop().create_task(self._run_batch(batch))
+
+    async def _run_batch(self, batch) -> None:
+        sigs = [b[0] for b in batch]
+        hashes = [b[1] for b in batch]
+        voters = [b[2] for b in batch]
+        try:
+            # Device dispatch blocks; keep the event loop live under it.
+            results = await asyncio.to_thread(
+                self._provider.verify_batch, sigs, hashes, voters)
+        except Exception:  # noqa: BLE001 — malformed input is never fatal
+            logger.exception("frontier batch verification errored")
+            results = [False] * len(batch)
+        self.stats.batches += 1
+        self.stats.max_batch = max(self.stats.max_batch, len(batch))
+        for (_, _, _, fut), ok in zip(batch, results):
+            if not ok:
+                self.stats.failures += 1
+            if not fut.done():
+                fut.set_result(bool(ok))

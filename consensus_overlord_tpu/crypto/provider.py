@@ -108,6 +108,14 @@ class CpuBlsCrypto:
         return bls.aggregate_verify_same_message(
             voters, hash32, agg_sig, self._common_ref)
 
+    def verify_batch(self, signatures: Sequence[bytes],
+                     hashes: Sequence[bytes],
+                     voters: Sequence[bytes]) -> List[bool]:
+        """Loop fallback for the batching-frontier interface (the TPU
+        provider overrides this with a device-batched path)."""
+        return [self.verify_signature(s, h, v)
+                for s, h, v in zip(signatures, hashes, voters)]
+
 
 class Ed25519Crypto:
     """Fast host-CPU provider for large simulations (Ed25519 via the
@@ -167,3 +175,10 @@ class Ed25519Crypto:
             self.verify_signature(
                 agg_sig[i * self.SIG_LEN:(i + 1) * self.SIG_LEN], hash32, v)
             for i, v in enumerate(voters))
+
+    def verify_batch(self, signatures: Sequence[bytes],
+                     hashes: Sequence[bytes],
+                     voters: Sequence[bytes]) -> List[bool]:
+        """Loop fallback for the batching-frontier interface."""
+        return [self.verify_signature(s, h, v)
+                for s, h, v in zip(signatures, hashes, voters)]

@@ -175,11 +175,17 @@ class Engine:
     MAX_PENDING = 4096  # future-message buffer bound
 
     def __init__(self, name: Address, adapter: ConsensusAdapter,
-                 crypto: CryptoProvider, wal: Wal):
+                 crypto: CryptoProvider, wal: Wal,
+                 inbound_verified: bool = False):
         self.name = bytes(name)
         self.adapter = adapter
         self.crypto = crypto
         self.wal = wal
+        #: True when a batching frontier (crypto/frontier.py) verifies
+        #: inbound message signatures before injection; the engine then
+        #: skips its per-message verifies (QC aggregate checks remain —
+        #: they bind signatures to the voter bitmap).
+        self.inbound_verified = inbound_verified
         self._mailbox: asyncio.Queue = asyncio.Queue()
         self.handler = EngineHandler(self._mailbox)
 
@@ -515,7 +521,7 @@ class Engine:
         if p.proposer != expected_leader or not self._is_validator(p.proposer):
             logger.warning("%s: proposal from non-leader", self._tag())
             return
-        if not self.crypto.verify_signature(
+        if not self.inbound_verified and not self.crypto.verify_signature(
                 sp.signature, sm3_hash(p.encode()), p.proposer):
             logger.warning("%s: bad proposal signature", self._tag())
             return
@@ -630,7 +636,7 @@ class Engine:
             return
         if sv.voter in vote_set.by_hash.get(v.block_hash, {}):
             return  # duplicate
-        if not self.crypto.verify_signature(
+        if not self.inbound_verified and not self.crypto.verify_signature(
                 sv.signature, sm3_hash(v.encode()), sv.voter):
             logger.warning("%s: bad vote signature from %s", self._tag(),
                            sv.voter[:4].hex())
@@ -752,7 +758,7 @@ class Engine:
         chokes = self._chokes.setdefault(c.round, {})
         if sc.address in chokes:
             return
-        if not self.crypto.verify_signature(
+        if not self.inbound_verified and not self.crypto.verify_signature(
                 sc.signature, sm3_hash(c.encode()), sc.address):
             logger.warning("%s: bad choke signature", self._tag())
             return

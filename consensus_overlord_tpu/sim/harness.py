@@ -63,14 +63,26 @@ class SimAdapter:
 
 
 class SimNode:
-    """One validator: crypto + WAL + adapter + engine + network registration."""
+    """One validator: crypto + WAL + adapter + engine + network registration.
+
+    use_frontier: verify inbound signatures at a batching frontier
+    (crypto/frontier.py) instead of one-at-a-time inside the engine — the
+    TPU-shaped configuration (SURVEY.md §7 "batching frontier")."""
 
     def __init__(self, crypto: CryptoProvider, router: Router,
-                 controller: SimController, wal: Optional[Wal] = None):
+                 controller: SimController, wal: Optional[Wal] = None,
+                 use_frontier: bool = False, frontier_max_batch: int = 1024,
+                 frontier_linger_s: float = 0.002):
+        from ..crypto.frontier import BatchingVerifier
+
         self.crypto = crypto
         self.wal = wal if wal is not None else MemoryWal()
         self.adapter = SimAdapter(crypto.pub_key, router, controller)
-        self.engine = Engine(crypto.pub_key, self.adapter, crypto, self.wal)
+        self.frontier = (BatchingVerifier(crypto, frontier_max_batch,
+                                          frontier_linger_s)
+                         if use_frontier else None)
+        self.engine = Engine(crypto.pub_key, self.adapter, crypto, self.wal,
+                             inbound_verified=use_frontier)
         self.router = router
         self._task: Optional[asyncio.Task] = None
         router.register(crypto.pub_key, self._on_network_msg)
@@ -89,6 +101,11 @@ class SimNode:
             logger.warning("[%s] dropped malformed %s", self.name[:4].hex(),
                            msg_type)
             return
+        if self.frontier is not None:
+            if not await self.frontier.verify_msg(msg):
+                logger.warning("[%s] frontier dropped %s (bad signature)",
+                               self.name[:4].hex(), msg_type)
+                return
         self.engine.handler.send_msg(msg)
 
     def start(self, init_height: int, interval_ms: int,
@@ -113,7 +130,8 @@ class SimNetwork:
     def __init__(self, n_validators: int = 4, block_interval_ms: int = 200,
                  seed: int = 0, drop_rate: float = 0.0,
                  delay_range: tuple[float, float] = (0.0, 0.0),
-                 crypto_factory=None):
+                 crypto_factory=None, use_frontier: bool = False,
+                 frontier_linger_s: float = 0.002):
         if crypto_factory is None:
             crypto_factory = lambda i: Ed25519Crypto(  # noqa: E731
                 i.to_bytes(4, "big") * 8)
@@ -122,7 +140,9 @@ class SimNetwork:
         cryptos = [crypto_factory(i) for i in range(n_validators)]
         self.controller = SimController(
             [c.pub_key for c in cryptos], block_interval_ms)
-        self.nodes = [SimNode(c, self.router, self.controller)
+        self.nodes = [SimNode(c, self.router, self.controller,
+                              use_frontier=use_frontier,
+                              frontier_linger_s=frontier_linger_s)
                       for c in cryptos]
         self.controller.on_new_height.append(self._push_status)
 

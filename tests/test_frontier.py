@@ -1,0 +1,110 @@
+"""Batching frontier: coalescing, correctness, and end-to-end consensus."""
+
+import asyncio
+
+import pytest
+
+from consensus_overlord_tpu.core.sm3 import sm3_hash
+from consensus_overlord_tpu.crypto.frontier import (
+    BatchingVerifier, signature_claims)
+from consensus_overlord_tpu.crypto.provider import Ed25519Crypto
+from consensus_overlord_tpu.sim.harness import SimNetwork
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class CountingProvider(Ed25519Crypto):
+    """Ed25519 provider that records verify_batch call sizes."""
+
+    def __init__(self, seed):
+        super().__init__(seed)
+        self.batch_sizes = []
+
+    def verify_batch(self, sigs, hashes, voters):
+        self.batch_sizes.append(len(sigs))
+        return super().verify_batch(sigs, hashes, voters)
+
+
+class TestBatching:
+    def test_concurrent_requests_coalesce(self):
+        async def go():
+            prov = CountingProvider(b"\x01" * 32)
+            h = sm3_hash(b"m")
+            sig = prov.sign(h)
+            fr = BatchingVerifier(prov, max_batch=64, linger_s=0.01)
+            results = await asyncio.gather(
+                *(fr.verify(sig, h, prov.pub_key) for _ in range(20)))
+            assert all(results)
+            assert prov.batch_sizes == [20]
+            assert fr.stats.batches == 1 and fr.stats.requests == 20
+        run(go())
+
+    def test_max_batch_flushes_immediately(self):
+        async def go():
+            prov = CountingProvider(b"\x02" * 32)
+            h = sm3_hash(b"m")
+            sig = prov.sign(h)
+            fr = BatchingVerifier(prov, max_batch=8, linger_s=10.0)
+            results = await asyncio.gather(
+                *(fr.verify(sig, h, prov.pub_key) for _ in range(8)))
+            assert all(results)  # would hang for 10s if linger were waited
+            assert prov.batch_sizes == [8]
+        run(go())
+
+    def test_bad_signatures_fail_individually(self):
+        async def go():
+            prov = CountingProvider(b"\x03" * 32)
+            other = Ed25519Crypto(b"\x04" * 32)
+            h = sm3_hash(b"m")
+            good, bad = prov.sign(h), other.sign(h)
+            fr = BatchingVerifier(prov, max_batch=64, linger_s=0.005)
+            r = await asyncio.gather(
+                fr.verify(good, h, prov.pub_key),
+                fr.verify(bad, h, prov.pub_key),
+                fr.verify(b"garbage", h, prov.pub_key))
+            assert r == [True, False, False]
+            assert fr.stats.failures == 2
+        run(go())
+
+    def test_provider_exception_degrades_to_false(self):
+        class Exploding:
+            def verify_batch(self, *a):
+                raise RuntimeError("device on fire")
+
+        async def go():
+            fr = BatchingVerifier(Exploding(), max_batch=4, linger_s=0.001)
+            assert await fr.verify(b"s", b"h", b"v") is False
+        run(go())
+
+
+class TestClaims:
+    def test_signature_claims_cover_wire_types(self):
+        from consensus_overlord_tpu.core.types import (
+            Choke, Proposal, SignedChoke, SignedProposal, SignedVote, Status,
+            Vote, VoteType)
+        p = Proposal(1, 0, b"c", sm3_hash(b"c"), None, b"me")
+        sp = SignedProposal(p, b"sig")
+        assert signature_claims(sp) == (b"sig", sm3_hash(p.encode()), b"me")
+        v = Vote(1, 0, VoteType.PREVOTE, sm3_hash(b"c"))
+        sv = SignedVote(b"voter", b"sig2", v)
+        assert signature_claims(sv) == (b"sig2", sm3_hash(v.encode()), b"voter")
+        c = Choke(1, 0)
+        sc = SignedChoke(b"sig3", b"addr", c)
+        assert signature_claims(sc) == (b"sig3", sm3_hash(c.encode()), b"addr")
+        assert signature_claims(Status(1, 3000, None, [])) is None
+
+
+class TestEndToEnd:
+    def test_consensus_with_frontier(self):
+        async def go():
+            net = SimNetwork(n_validators=4, block_interval_ms=50,
+                             use_frontier=True, frontier_linger_s=0.001)
+            net.start()
+            await net.run_until_height(5, timeout=30.0)
+            await net.stop()
+            stats = [n.frontier.stats for n in net.nodes]
+            assert sum(s.requests for s in stats) > 0
+            assert all(s.failures == 0 for s in stats)
+        run(go())
