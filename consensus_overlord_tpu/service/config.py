@@ -1,0 +1,74 @@
+"""Service configuration: the `[consensus_overlord]` TOML section.
+
+Field names, defaults, and section scoping mirror the reference's config
+surface (reference src/config.rs:18-56; example/config.toml), so a
+reference deployment's config file drops in unchanged.  Extra
+`crypto_backend` / frontier fields configure the TPU-specific machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+DEFAULT_METRICS_BUCKETS = [
+    0.25, 0.5, 0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 25.0, 50.0, 75.0, 100.0,
+    250.0, 500.0,
+]  # reference src/config.rs:43-45
+
+
+@dataclass
+class LogConfig:
+    """Nested log settings (reference README.md:58-63)."""
+
+    max_level: str = "info"
+    filter: str = "info"
+    service_name: str = "consensus"
+    rolling_file_path: Optional[str] = None
+    agent_endpoint: Optional[str] = None
+
+
+@dataclass
+class ConsensusConfig:
+    network_port: int = 50000            # src/config.rs:22 default shape
+    consensus_port: int = 50001
+    controller_port: int = 50004
+    server_retry_interval: int = 1       # seconds (src/config.rs:39)
+    wal_path: str = "overlord_wal"       # src/config.rs:40
+    enable_metrics: bool = True
+    metrics_port: int = 60001
+    metrics_buckets: List[float] = field(
+        default_factory=lambda: list(DEFAULT_METRICS_BUCKETS))
+    domain: str = ""
+    log_config: LogConfig = field(default_factory=LogConfig)
+
+    # TPU-framework extensions (absent from the reference).
+    crypto_backend: str = "tpu"          # "tpu" | "cpu"
+    frontier_max_batch: int = 1024
+    frontier_linger_ms: float = 2.0
+
+    @classmethod
+    def load(cls, path: str,
+             section: str = "consensus_overlord") -> "ConsensusConfig":
+        """Read one named TOML section with per-field defaults (the
+        reference's read_toml + serde-default shape, src/config.rs:52-56)."""
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        table = doc.get(section, {})
+        return cls.from_dict(table)
+
+    @classmethod
+    def from_dict(cls, table: dict) -> "ConsensusConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for key, value in table.items():
+            if key not in known:
+                continue  # unknown keys ignored, serde-style
+            if key == "log_config" and isinstance(value, dict):
+                log_known = {f.name for f in dataclasses.fields(LogConfig)}
+                value = LogConfig(**{k: v for k, v in value.items()
+                                     if k in log_known})
+            kwargs[key] = value
+        return cls(**kwargs)

@@ -1,0 +1,8 @@
+"""Generated protobuf message classes (see protos/consensus_overlord.proto).
+
+Regenerate with:
+    protoc --python_out=consensus_overlord_tpu/service/pb -I protos \
+        protos/consensus_overlord.proto
+"""
+
+from . import consensus_overlord_pb2 as pb2  # noqa: F401

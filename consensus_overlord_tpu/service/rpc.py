@@ -1,0 +1,152 @@
+"""gRPC plumbing without generated stubs: method descriptors + generic
+handlers/clients over the protobuf message classes.
+
+(The build environment ships protoc but not the gRPC python plugin, so
+service stubs are declared here with grpc's generic APIs — functionally
+identical to *_pb2_grpc.py output.)
+
+Status codes are this stack's own enum (the reference returns members of
+its ecosystem's status-code set, reference src/main.rs:100-124; ours is
+self-consistent across the services we both serve and consume).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, Optional
+
+import grpc
+
+from .pb import pb2
+
+logger = logging.getLogger("consensus_overlord_tpu.rpc")
+
+_PKG = "consensus_overlord_tpu"
+
+
+class Code:
+    SUCCESS = 0
+    PROPOSAL_CHECK_ERROR = 1
+    NOT_READY = 2
+    INVALID_ARGUMENT = 3
+    INTERNAL_ERROR = 4
+    NO_PROPOSAL = 5
+
+
+# method name → (request class, response class), per service.
+CONSENSUS_SERVICE = {
+    "Reconfigure": (pb2.ConsensusConfiguration, pb2.StatusCode),
+    "CheckBlock": (pb2.ProposalWithProof, pb2.StatusCode),
+}
+NETWORK_MSG_HANDLER_SERVICE = {
+    "ProcessNetworkMsg": (pb2.NetworkMsg, pb2.StatusCode),
+}
+HEALTH_SERVICE = {
+    "Check": (pb2.HealthCheckRequest, pb2.HealthCheckResponse),
+}
+NETWORK_SERVICE = {
+    "RegisterNetworkMsgHandler": (pb2.RegisterInfo, pb2.StatusCode),
+    "Broadcast": (pb2.NetworkMsg, pb2.StatusCode),
+    "SendMsg": (pb2.NetworkMsg, pb2.StatusCode),
+}
+CONTROLLER_SERVICE = {
+    "GetProposal": (pb2.Empty, pb2.ProposalResponse),
+    "CheckProposal": (pb2.Proposal, pb2.StatusCode),
+    "CommitBlock": (pb2.ProposalWithProof, pb2.ConsensusConfigurationResponse),
+}
+
+
+def generic_handler(service_name: str, methods: Dict[str, tuple],
+                    impl) -> grpc.GenericRpcHandler:
+    """Build a generic handler binding `impl.<SnakeCase>` coroutines to the
+    service's methods."""
+    handlers = {}
+    for method, (req_cls, resp_cls) in methods.items():
+        snake = "".join(
+            ("_" + c.lower()) if c.isupper() else c for c in method
+        ).lstrip("_")
+        fn = getattr(impl, snake)
+        handlers[method] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString)
+    return grpc.method_handlers_generic_handler(
+        f"{_PKG}.{service_name}", handlers)
+
+
+class RetryClient:
+    """Async unary client for one service with bounded-retry semantics —
+    the analog of the retry middleware every reference outbound call is
+    wrapped in (reference src/util.rs:20, 25-29)."""
+
+    def __init__(self, address: str, service_name: str,
+                 methods: Dict[str, tuple], retries: int = 3,
+                 retry_delay_s: float = 0.3):
+        self._channel = grpc.aio.insecure_channel(address)
+        self._retries = retries
+        self._delay = retry_delay_s
+        self._calls = {}
+        for method, (req_cls, resp_cls) in methods.items():
+            self._calls[method] = self._channel.unary_unary(
+                f"/{_PKG}.{service_name}/{method}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString)
+
+    async def call(self, method: str, request, timeout: float = 10.0):
+        last_exc: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                return await self._calls[method](request, timeout=timeout)
+            except grpc.aio.AioRpcError as e:  # transient transport errors
+                last_exc = e
+                if attempt + 1 < self._retries:
+                    await asyncio.sleep(self._delay * (attempt + 1))
+        raise last_exc
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+
+class NetworkClient(RetryClient):
+    """Client of the sibling network microservice (reference
+    src/util.rs:25-44)."""
+
+    def __init__(self, port: int, host: str = "localhost", **kw):
+        super().__init__(f"{host}:{port}", "NetworkService",
+                         NETWORK_SERVICE, **kw)
+
+    async def register_network_msg_handler(self, module: str, hostname: str,
+                                           port: int) -> int:
+        resp = await self.call("RegisterNetworkMsgHandler", pb2.RegisterInfo(
+            module_name=module, hostname=hostname, port=str(port)))
+        return resp.code
+
+    async def broadcast(self, msg: pb2.NetworkMsg) -> int:
+        return (await self.call("Broadcast", msg)).code
+
+    async def send_msg(self, msg: pb2.NetworkMsg) -> int:
+        return (await self.call("SendMsg", msg)).code
+
+
+class ControllerClient(RetryClient):
+    """Client of the sibling controller microservice (reference
+    src/util.rs:46-59)."""
+
+    def __init__(self, port: int, host: str = "localhost", **kw):
+        super().__init__(f"{host}:{port}", "Consensus2ControllerService",
+                         CONTROLLER_SERVICE, **kw)
+
+    async def get_proposal(self) -> pb2.ProposalResponse:
+        return await self.call("GetProposal", pb2.Empty())
+
+    async def check_proposal(self, height: int, data: bytes) -> int:
+        resp = await self.call(
+            "CheckProposal", pb2.Proposal(height=height, data=data))
+        return resp.code
+
+    async def commit_block(
+            self, height: int, data: bytes,
+            proof: bytes) -> pb2.ConsensusConfigurationResponse:
+        return await self.call("CommitBlock", pb2.ProposalWithProof(
+            proposal=pb2.Proposal(height=height, data=data), proof=proof))
