@@ -527,7 +527,14 @@ def final_exponentiation_naive(f: Fq12) -> Fq12:
 
 
 def pairing(q, p) -> Fq12:
-    """e(P, Q) with P ∈ G1, Q ∈ G2' (affine Fq/Fq2 points)."""
+    """e(P, Q)³ with P ∈ G1, Q ∈ G2' (affine Fq/Fq2 points).
+
+    NOTE: the fast final_exponentiation computes f^(3·e), so this returns
+    the CUBE of the standard ate pairing value.  Since 3 ∤ r, cubing is a
+    bijection on the r-th roots of unity: every in-repo use (== 1 tests,
+    cross-pairing equality) is invariant.  For byte-level comparison
+    against external pairing test vectors, use
+    final_exponentiation_naive(miller_loop(...)) instead."""
     return final_exponentiation(miller_loop(untwist(q), (fq_to_fq12(p[0]), fq_to_fq12(p[1]))))
 
 

@@ -73,6 +73,9 @@ class BatchingVerifier:
         self._linger = linger_s
         self._pending: List[Tuple[bytes, bytes, bytes, asyncio.Future]] = []
         self._flush_task: Optional[asyncio.Task] = None
+        # asyncio holds only weak refs to tasks; in-flight batch tasks must
+        # be pinned or GC can collect one mid-verify, hanging every waiter.
+        self._inflight: set = set()
         self.stats = FrontierStats()
 
     async def verify(self, signature: bytes, hash32: bytes,
@@ -107,7 +110,9 @@ class BatchingVerifier:
         if self._flush_task is not None and not self._flush_task.done():
             self._flush_task.cancel()
         self._flush_task = None
-        asyncio.get_running_loop().create_task(self._run_batch(batch))
+        task = asyncio.get_running_loop().create_task(self._run_batch(batch))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
 
     async def _run_batch(self, batch) -> None:
         sigs = [b[0] for b in batch]

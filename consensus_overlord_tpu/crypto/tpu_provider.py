@@ -115,6 +115,41 @@ def _g2_sum(px, py, pz):
     return ax[0], ay[0], ainf[0]
 
 
+class _SingleChipKernels:
+    """The module-level jits above, as the default kernel set."""
+
+    g1_validate_msm = staticmethod(lambda *a: _g1_validate_msm(*a))
+    g2_validate = staticmethod(lambda *a: _g2_validate(*a))
+    g2_msm = staticmethod(lambda *a: _g2_msm(*a))
+    g1_validate_sum = staticmethod(lambda *a: _g1_validate_sum(*a))
+    g2_sum = staticmethod(lambda *a: _g2_sum(*a))
+    lanes = 1
+
+
+class _MeshKernels:
+    """The same kernel surface jitted over a device mesh via shard_map
+    (parallel/sharded.py): signature/pubkey lanes shard across devices,
+    partial group sums combine over the mesh axis (ICI).  Batch padding
+    must be a multiple of the mesh size; the provider's pad ladder is
+    adjusted through `lanes`."""
+
+    def __init__(self, mesh):
+        from ..parallel import (
+            sharded_g1_validate_sum,
+            sharded_g1_verify_msm,
+            sharded_g2_msm,
+            sharded_g2_sum,
+            sharded_g2_validate,
+        )
+        self.mesh = mesh
+        self.lanes = mesh.devices.size
+        self.g1_validate_msm = sharded_g1_verify_msm(mesh)
+        self.g2_validate = sharded_g2_validate(mesh)
+        self.g2_msm = sharded_g2_msm(mesh)
+        self.g1_validate_sum = sharded_g1_validate_sum(mesh)
+        self.g2_sum = sharded_g2_sum(mesh)
+
+
 def _affine_to_oracle_g1(ax, ay, ainf) -> Optional[Tuple[int, int]]:
     if bool(ainf):
         return None
@@ -141,14 +176,26 @@ class TpuBlsCrypto:
     """
 
     def __init__(self, private_key: int, common_ref: bytes = b"",
-                 device_threshold: int = 32):
+                 device_threshold: int = 32, mesh=None):
+        """mesh: optional jax.sharding.Mesh — batches then shard across its
+        devices through the parallel/sharded.py kernels (single-chip jits
+        otherwise).  Pass parallel.make_mesh() to use every local device."""
         self._cpu = CpuBlsCrypto(private_key, common_ref)
         self._common_ref = common_ref
         self._threshold = device_threshold
+        self._kernels = (_MeshKernels(mesh) if mesh is not None
+                         and mesh.devices.size > 1 else _SingleChipKernels)
         # voter bytes → (device row arrays, oracle affine point) for
         # validated pubkeys; None for known-bad keys.
         self._pk_cache: Dict[bytes, Optional[Tuple[np.ndarray, np.ndarray,
                                                    np.ndarray, tuple]]] = {}
+
+    def _pad_to(self, n: int) -> int:
+        """Pad ladder size, kept a multiple of the mesh lane count so
+        shard_map can split the batch axis evenly."""
+        size = _pad_to(n)
+        lanes = self._kernels.lanes
+        return -(-size // lanes) * lanes
 
     # -- provider surface ----------------------------------------------------
 
@@ -175,7 +222,7 @@ class TpuBlsCrypto:
         if len(signatures) < self._threshold:
             return self._cpu.aggregate_signatures(signatures, voters)
         n = len(signatures)
-        size = _pad_to(n)
+        size = self._pad_to(n)
         parsed = dev.parse_g1_compressed(list(signatures))
         x = np.zeros((size, dev.FQ.n), np.int32)
         x[:n] = parsed.x
@@ -185,7 +232,7 @@ class TpuBlsCrypto:
         inf[:n] = parsed.infinity
         ok = np.zeros(size, bool)
         ok[:n] = parsed.wellformed
-        ax, ay, ainf, valid = _g1_validate_sum(
+        ax, ay, ainf, valid = self._kernels.g1_validate_sum(
             jnp.asarray(x), jnp.asarray(sign_f), jnp.asarray(inf),
             jnp.asarray(ok))
         if not bool(np.asarray(valid)[:n].all()):
@@ -201,7 +248,7 @@ class TpuBlsCrypto:
         if rows is None:
             return False
         px, py, pz = rows
-        agg_pk = _affine_to_oracle_g2(*_g2_sum(
+        agg_pk = _affine_to_oracle_g2(*self._kernels.g2_sum(
             jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz)))
         if agg_pk is None:
             return False
@@ -237,7 +284,7 @@ class TpuBlsCrypto:
         pk_ok = np.array(
             [self._pk_cache[bytes(v)] is not None for v in voters], bool)
 
-        size = _pad_to(n)
+        size = self._pad_to(n)
         parsed = dev.parse_g1_compressed(list(signatures))
         sx = np.zeros((size, dev.FQ.n), np.int32)
         sx[:n] = parsed.x
@@ -250,15 +297,16 @@ class TpuBlsCrypto:
         sok[:n] = parsed.wellformed & pk_ok
 
         # Random 128-bit scalars (nonzero); padding lanes get scalar 0.
-        scalars = [
-            (1 << (_SCALAR_BITS - 1)) | secrets.randbits(_SCALAR_BITS - 1)
-            for _ in range(n)]
+        # One vectorized unpackbits, not a Python double loop (which costs
+        # ~100 ms per 1024-lane batch).
+        packed = np.frombuffer(
+            secrets.token_bytes(n * _SCALAR_BITS // 8),
+            np.uint8).reshape(n, _SCALAR_BITS // 8).copy()
+        packed[:, 0] |= 0x80  # force the top bit: scalars nonzero
         bits = np.zeros((size, _SCALAR_BITS), np.int32)
-        for i, r in enumerate(scalars):
-            for j in range(_SCALAR_BITS):
-                bits[i, _SCALAR_BITS - 1 - j] = (r >> j) & 1
+        bits[:n] = np.unpackbits(packed, axis=1)
 
-        ax, ay, ainf, valid = _g1_validate_msm(
+        ax, ay, ainf, valid = self._kernels.g1_validate_msm(
             jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
             jnp.asarray(sok), jnp.asarray(bits))
         valid = np.asarray(valid)[:n] & pk_ok
@@ -275,7 +323,7 @@ class TpuBlsCrypto:
         neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
         pairs = [(agg_sig, neg_g2)]
         for h, idxs in groups.items():
-            gsize = _pad_to(len(idxs))
+            gsize = self._pad_to(len(idxs))
             px = np.zeros((gsize, 2, dev.FQ.n), np.int32)
             py = np.zeros((gsize, 2, dev.FQ.n), np.int32)
             pz = np.zeros((gsize, 2, dev.FQ.n), np.int32)
@@ -284,7 +332,7 @@ class TpuBlsCrypto:
                 rx, ry, rz, _aff = self._pk_cache[bytes(voters[i])]
                 px[j], py[j], pz[j] = rx, ry, rz
                 gbits[j] = bits[i]
-            agg_pk = _affine_to_oracle_g2(*_g2_msm(
+            agg_pk = _affine_to_oracle_g2(*self._kernels.g2_msm(
                 jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz),
                 jnp.asarray(gbits)))
             h_pt = oracle.hash_to_g1(h, self._common_ref)
@@ -336,7 +384,7 @@ class TpuBlsCrypto:
         n = len(voters)
         if n == 0:
             return
-        size = _pad_to(n)
+        size = self._pad_to(n)
         parsed = dev.parse_g2_compressed(voters)
         x = np.zeros((size, 2, dev.FQ.n), np.int32)
         x[:n] = parsed.x
@@ -346,7 +394,7 @@ class TpuBlsCrypto:
         inf[:n] = parsed.infinity
         ok = np.zeros(size, bool)
         ok[:n] = parsed.wellformed
-        px, py, pz, valid = _g2_validate(
+        px, py, pz, valid = self._kernels.g2_validate(
             jnp.asarray(x), jnp.asarray(sgn), jnp.asarray(inf),
             jnp.asarray(ok))
         px, py, pz = np.asarray(px), np.asarray(py), np.asarray(pz)
@@ -365,7 +413,7 @@ class TpuBlsCrypto:
         verify)."""
         self._ensure_pubkeys(voters)
         n = len(voters)
-        size = _pad_to(n)
+        size = self._pad_to(n)
         px = np.zeros((size, 2, dev.FQ.n), np.int32)
         py = np.zeros((size, 2, dev.FQ.n), np.int32)
         pz = np.zeros((size, 2, dev.FQ.n), np.int32)

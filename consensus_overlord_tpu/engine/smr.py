@@ -176,16 +176,20 @@ class Engine:
 
     def __init__(self, name: Address, adapter: ConsensusAdapter,
                  crypto: CryptoProvider, wal: Wal,
-                 inbound_verified: bool = False):
+                 frontier=None):
         self.name = bytes(name)
         self.adapter = adapter
         self.crypto = crypto
         self.wal = wal
-        #: True when a batching frontier (crypto/frontier.py) verifies
-        #: inbound message signatures before injection; the engine then
-        #: skips its per-message verifies (QC aggregate checks remain —
-        #: they bind signatures to the voter bitmap).
-        self.inbound_verified = inbound_verified
+        #: Optional batching frontier (crypto/frontier.py).  When present,
+        #: inbound messages entering through inject_inbound() have their
+        #: signatures verified there in device-sized batches, and the
+        #: engine skips its per-message verifies (QC aggregate checks
+        #: remain — they bind signatures to the voter bitmap).  The engine
+        #: holds the frontier itself so the skip can never be enabled
+        #: without a verifier actually guarding the injection path.
+        self.frontier = frontier
+        self.inbound_verified = frontier is not None
         self._mailbox: asyncio.Queue = asyncio.Queue()
         self.handler = EngineHandler(self._mailbox)
 
@@ -272,6 +276,20 @@ class Engine:
     def stop(self) -> None:
         self._running = False
         self._mailbox.put_nowait(_Stop())
+
+    async def inject_inbound(self, msg) -> bool:
+        """The inbound-network injection point (the reference's
+        proc_network_msg tail, src/consensus.rs:214-252).  With a frontier,
+        the message's signature claim is batch-verified first and bad
+        signatures are dropped here; without one, the engine's per-message
+        verifies in the handlers apply.  Returns False iff dropped."""
+        if self.frontier is not None:
+            if not await self.frontier.verify_msg(msg):
+                logger.warning("%s: frontier dropped %s (bad signature)",
+                               self._tag(), type(msg).__name__)
+                return False
+        self.handler.send_msg(msg)
+        return True
 
     # -- internals ---------------------------------------------------------
 

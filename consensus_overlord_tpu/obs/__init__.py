@@ -1,0 +1,24 @@
+"""Observability: metrics, logging, and trace-context propagation.
+
+The reference gets these from `cloud_util` (reference src/main.rs:173-175
+tracer init; src/main.rs:248-260 metrics middleware + exporter; tracing
+`#[instrument]` spans with cross-service parent propagation at
+src/main.rs:96, 111, 137).  Here:
+
+  metrics.py — per-RPC latency histograms (the MiddlewareLayer analog) +
+               a Prometheus exporter on `metrics_port`
+  logctx.py  — logging init from LogConfig + W3C traceparent extraction
+               from gRPC metadata into a contextvar, stamped onto every
+               log record (the `set_parent` analog)
+"""
+
+from .logctx import init_logging, trace_context, TraceContextInterceptor
+from .metrics import Metrics, MetricsInterceptor
+
+__all__ = [
+    "Metrics",
+    "MetricsInterceptor",
+    "TraceContextInterceptor",
+    "init_logging",
+    "trace_context",
+]

@@ -1,0 +1,87 @@
+"""Logging init + distributed trace-context propagation.
+
+The reference initializes a tracer from the TOML log config (rolling file,
+level, optional Jaeger agent; reference src/main.rs:173-175, README.md:58-63)
+and restores the W3C trace parent from inbound gRPC metadata on every
+handler (`cloud_util::tracer::set_parent`, src/main.rs:96, 111, 137).
+
+Here: stdlib logging configured from LogConfig, and a server interceptor
+that parses the `traceparent` metadata key into a contextvar which a log
+filter stamps onto every record — so one request's log lines across
+engine/frontier/brain share its trace id, greppable end-to-end.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import logging.handlers
+import re
+from typing import Optional
+
+import grpc
+
+#: current request's trace id ("-" outside any traced request)
+trace_context: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "trace_context", default="-")
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$")
+
+_FORMAT = ("%(asctime)s %(levelname)-5s %(name)s "
+           "[trace=%(trace_id)s] %(message)s")
+
+
+class _TraceFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.trace_id = trace_context.get()
+        return True
+
+
+def init_logging(log_config=None, service_name: str = "consensus") -> None:
+    """Configure root logging per LogConfig (service/config.py): level from
+    max_level, optional rolling file via rolling_file_path (the reference's
+    rolling-file tracer output, README.md:62)."""
+    level = getattr(logging, (log_config.max_level if log_config else
+                              "info").upper(), logging.INFO)
+    handlers: list = [logging.StreamHandler()]
+    if log_config is not None and log_config.rolling_file_path:
+        handlers.append(logging.handlers.RotatingFileHandler(
+            log_config.rolling_file_path, maxBytes=64 << 20, backupCount=4))
+    trace_filter = _TraceFilter()
+    for h in handlers:
+        h.setFormatter(logging.Formatter(_FORMAT))
+        h.addFilter(trace_filter)
+    root = logging.getLogger()
+    root.setLevel(level)
+    root.handlers = handlers
+
+
+class TraceContextInterceptor(grpc.aio.ServerInterceptor):
+    """Extract `traceparent` from request metadata into the contextvar —
+    the set_parent analog (reference src/main.rs:96, 111, 137)."""
+
+    async def intercept_service(self, continuation, handler_call_details):
+        trace_id: Optional[str] = None
+        for key, value in handler_call_details.invocation_metadata or ():
+            if key == "traceparent" and isinstance(value, str):
+                m = _TRACEPARENT_RE.match(value)
+                if m:
+                    trace_id = m.group(1)
+        handler = await continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None or trace_id is None:
+            return handler
+        inner = handler.unary_unary
+        tid = trace_id
+
+        async def with_ctx(request, context):
+            token = trace_context.set(tid)
+            try:
+                return await inner(request, context)
+            finally:
+                trace_context.reset(token)
+
+        return grpc.unary_unary_rpc_method_handler(
+            with_ctx,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer)

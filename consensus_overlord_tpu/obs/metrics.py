@@ -1,0 +1,105 @@
+"""Per-RPC latency histograms + Prometheus exporter.
+
+The reference wraps its tonic server in a `MiddlewareLayer` that measures
+every gRPC request into configurable histogram buckets and serves them from
+a separate exporter task on `metrics_port` (reference src/main.rs:248-260;
+bucket defaults src/config.rs:43-45 — values are milliseconds, 0.25..500).
+
+Here the middleware is a grpc.aio server interceptor and the exporter is
+prometheus_client's threaded HTTP server.  Each `Metrics` owns its own
+registry so multiple nodes can live in one test process.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import grpc
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Histogram,
+    start_http_server,
+)
+
+#: reference src/config.rs:43-45 (milliseconds)
+DEFAULT_BUCKETS = (0.25, 0.5, 0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 25.0, 50.0,
+                   75.0, 100.0, 250.0, 500.0)
+
+
+class Metrics:
+    """One node's metric surface: RPC latency histogram, engine counters,
+    frontier batch-size histogram."""
+
+    def __init__(self, buckets: Optional[Sequence[float]] = None):
+        self.registry = CollectorRegistry()
+        buckets = tuple(buckets or DEFAULT_BUCKETS)
+        self.rpc_latency_ms = Histogram(
+            "grpc_server_handling_ms",
+            "gRPC request handling latency (ms)",
+            ["method"], buckets=buckets, registry=self.registry)
+        self.rpc_total = Counter(
+            "grpc_server_handled_total",
+            "gRPC requests handled", ["method", "code"],
+            registry=self.registry)
+        self.frontier_batch_size = Histogram(
+            "frontier_batch_size",
+            "Signature-verification batch sizes at the frontier",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048),
+            registry=self.registry)
+        self.committed_heights = Counter(
+            "consensus_committed_heights_total",
+            "Heights committed by this node", registry=self.registry)
+        self._exporter = None
+
+    def interceptor(self) -> "MetricsInterceptor":
+        return MetricsInterceptor(self)
+
+    def start_exporter(self, port: int, addr: str = "0.0.0.0") -> int:
+        """Serve /metrics on `port` (0 = OS-assigned); returns the bound
+        port.  The reference's run_metrics_exporter analog
+        (src/main.rs:249-251)."""
+        server, _thread = start_http_server(
+            port, addr=addr, registry=self.registry)
+        self._exporter = server
+        return server.server_address[1]
+
+    def stop_exporter(self) -> None:
+        if self._exporter is not None:
+            self._exporter.shutdown()
+            self._exporter = None
+
+
+class MetricsInterceptor(grpc.aio.ServerInterceptor):
+    """Times every unary RPC into the latency histogram — the tower
+    MiddlewareLayer analog (reference src/main.rs:253-256)."""
+
+    def __init__(self, metrics: Metrics):
+        self._m = metrics
+
+    async def intercept_service(self, continuation, handler_call_details):
+        handler = await continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        method = handler_call_details.method
+        inner = handler.unary_unary
+        metrics = self._m
+
+        async def timed(request, context):
+            t0 = time.perf_counter()
+            code = "OK"
+            try:
+                return await inner(request, context)
+            except BaseException:
+                code = "ERROR"
+                raise
+            finally:
+                metrics.rpc_latency_ms.labels(method=method).observe(
+                    (time.perf_counter() - t0) * 1000.0)
+                metrics.rpc_total.labels(method=method, code=code).inc()
+
+        return grpc.unary_unary_rpc_method_handler(
+            timed,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer)

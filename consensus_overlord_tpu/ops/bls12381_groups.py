@@ -159,15 +159,72 @@ def g2_decompress_device(x: Array, sign: Array, infinity: Array,
 
 
 # ---------------------------------------------------------------------------
-# Subgroup membership: r·P == 𝒪.  (The r-torsion check blst performs before
-# pairing; batched here as one 255-iteration scan over the whole batch.)
+# Subgroup membership — endomorphism fast checks (the r-torsion check blst
+# performs before pairing).  Instead of the naive [r]P == 𝒪 (255
+# double-and-add iterations), use the eigenvalue criteria with the curve
+# parameter z = -0xd201000000010000 (r = z⁴ − z² + 1):
+#
+#   G1:  φ(x, y) = (β·x, y) with β a primitive cube root of unity in Fq
+#        acts on G1 as multiplication by λ = −z² (λ² + λ + 1 ≡ 0 mod r).
+#        P ∈ G1  ⇔  P on curve ∧ φ(P) == [−z²]P.
+#   G2:  ψ = twist∘Frobenius∘untwist, ψ(x, y) = (x̄·c_x, ȳ·c_y) with
+#        c_x = ξ^−((p−1)/3), c_y = ξ^−((p−1)/2), ξ = 1 + u, acts on G2 as
+#        multiplication by z.   Q ∈ G2  ⇔  Q on curve ∧ ψ(Q) == [z]Q.
+#
+# (The criteria are M. Scott, "A note on group membership tests for G1, G2
+# and GT on BLS pairing-friendly curves", 2021.)  |z| has Hamming weight 6,
+# so [z]P is 63 doubles + 5 adds — the checks cost ~70 (G1: ~140) point ops
+# instead of ~510, and tests/test_curve.py cross-checks them against the
+# naive full-order scalar mult and against out-of-subgroup curve points.
 # ---------------------------------------------------------------------------
 
+Z_ABS = 0xD201000000010000  # |z|; z itself is negative
+
+# β = 2^((p−1)/3) mod p — the cube root whose φ matches λ = −z² (the other
+# root matches λ²; asserted against the host oracle in tests).
+_BETA_INT = pow(2, (oracle.P - 1) // 3, oracle.P)
+_G1_BETA = jnp.asarray(FQ.from_int(_BETA_INT))
+
+# ψ twist constants over Fq2 (ξ = 1 + u).
+_PSI_CX_INT = oracle.fq2_inv(oracle._fq2_pow((1, 1), (oracle.P - 1) // 3))
+_PSI_CY_INT = oracle.fq2_inv(oracle._fq2_pow((1, 1), (oracle.P - 1) // 2))
+_PSI_CX = FQ2.from_ints([_PSI_CX_INT])[0]
+_PSI_CY = FQ2.from_ints([_PSI_CY_INT])[0]
+
+
+def g1_endomorphism(p: Point) -> Point:
+    """φ(X:Y:Z) = (βX : Y : Z) — the GLV endomorphism, one field mul."""
+    return Point(FQ.mul(p.x, _G1_BETA), p.y, p.z)
+
+
+def g2_endomorphism(p: Point) -> Point:
+    """ψ(X:Y:Z) = (c_x·X̄ : c_y·Ȳ : Z̄) (projective: conjugation is a ring
+    homomorphism, so it commutes with the X/Z, Y/Z division)."""
+    return Point(FQ2.mul(FQ2.conj(p.x), _PSI_CX),
+                 FQ2.mul(FQ2.conj(p.y), _PSI_CY),
+                 FQ2.conj(p.z))
+
+
 def g1_in_subgroup(p: Point) -> Array:
-    return G1.is_infinity(G1.scalar_mul_static(p, R)) & G1.on_curve(p)
+    """φ(P) == [−z²]P, via two sparse |z| ladders (the sign of z cancels
+    in z²; the negation lands on the right-hand side)."""
+    z2p = G1.scalar_mul_static(G1.scalar_mul_static(p, Z_ABS), Z_ABS)
+    return G1.eq(g1_endomorphism(p), G1.neg(z2p)) & G1.on_curve(p)
 
 
 def g2_in_subgroup(p: Point) -> Array:
+    """ψ(Q) == [z]Q = −[|z|]Q."""
+    zq = G2.neg(G2.scalar_mul_static(p, Z_ABS))
+    return G2.eq(g2_endomorphism(p), zq) & G2.on_curve(p)
+
+
+def g1_in_subgroup_full(p: Point) -> Array:
+    """Naive [r]P == 𝒪 — the reference semantics the fast check must agree
+    with (kept for cross-validation in tests)."""
+    return G1.is_infinity(G1.scalar_mul_static(p, R)) & G1.on_curve(p)
+
+
+def g2_in_subgroup_full(p: Point) -> Array:
     return G2.is_infinity(G2.scalar_mul_static(p, R)) & G2.on_curve(p)
 
 

@@ -12,4 +12,11 @@ range across devices plays the role tensor parallelism plays in ML stacks.
 """
 
 from .sharded import (  # noqa: F401
-    make_mesh, sharded_g1_verify_msm, sharded_g2_msm, sharded_round_step)
+    make_mesh,
+    sharded_g1_validate_sum,
+    sharded_g1_verify_msm,
+    sharded_g2_msm,
+    sharded_g2_sum,
+    sharded_g2_validate,
+    sharded_round_step,
+)

@@ -108,6 +108,57 @@ def sharded_g2_msm(mesh: Mesh, axis: str = AXIS):
     return jax.jit(fn)
 
 
+def sharded_g2_validate(mesh: Mesh, axis: str = AXIS):
+    """Decompress + subgroup-check a G2 pubkey batch, lanes sharded over
+    the mesh — purely data-parallel (no collective): each device validates
+    its shard.  (x, sign, inf, ok) → (px, py, pz, valid), all sharded."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis), P(axis), P(axis), P(axis)),
+             out_specs=(P(axis), P(axis), P(axis), P(axis)))
+    def fn(x, sign, inf, ok):
+        pt, valid = dev.g2_decompress_device(x, sign, inf, ok)
+        valid = valid & ~inf & dev.g2_in_subgroup(pt)
+        return pt.x, pt.y, pt.z, valid
+
+    return jax.jit(fn)
+
+
+def sharded_g1_validate_sum(mesh: Mesh, axis: str = AXIS):
+    """Decompress a G1 signature batch and tree-sum it (QC aggregation,
+    reference src/consensus.rs:418-444) over the mesh.  Returns replicated
+    affine aggregate + sharded validity."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis), P(axis), P(axis), P(axis)),
+             out_specs=(P(), P(), P(), P(axis)))
+    def fn(x, sign, inf, ok):
+        pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
+        local = dev.G1.tree_sum(
+            dev.G1.select(valid & ~inf, pt, dev.G1.infinity_like(x)))
+        total = _combine_replicated(dev.G1, local, axis)
+        ax, ay, ainf = dev.G1.to_affine(total)
+        return ax[0], ay[0], ainf[0], valid
+
+    return jax.jit(fn)
+
+
+def sharded_g2_sum(mesh: Mesh, axis: str = AXIS):
+    """Σ P_i over pre-validated G2 points sharded on the mesh (QC pubkey
+    aggregation, reference src/consensus.rs:365-383)."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis), P(axis), P(axis)),
+             out_specs=(P(), P(), P()))
+    def fn(px, py, pz):
+        local = dev.G2.tree_sum(Point(px, py, pz))
+        total = _combine_replicated(dev.G2, local, axis)
+        ax, ay, ainf = dev.G2.to_affine(total)
+        return ax[0], ay[0], ainf[0]
+
+    return jax.jit(fn)
+
+
 def sharded_round_step(mesh: Mesh, axis: str = AXIS):
     """The full per-round crypto step (the framework's "training step"):
     validate N vote signatures, reduce Σ r_i·S_i (G1) and Σ r_i·P_i (G2)

@@ -1,0 +1,177 @@
+"""Process bootstrap: CLI + startup orchestration + graceful shutdown —
+the reference's main.rs (src/main.rs:25-62 CLI, 165-297 run()).
+
+Startup sequence (mirrors src/main.rs:165-297):
+
+  1. load config, init logging/metrics
+  2. bind + start the gRPC server (ConsensusService / NetworkMsgHandler /
+     Health, with metrics + trace-context interceptors)
+  3. registration retry loop: block until the network service accepts
+     register_network_msg_handler, retrying every server_retry_interval
+     (src/main.rs:186-207) — the service is self-healing against a late
+     network sibling
+  4. reconfiguration-wait task: ping_controller() every tick until the
+     controller supplies a configuration, then start the engine
+     (src/main.rs:213-246)
+  5. serve until SIGINT/SIGTERM, then stop engine + server cleanly
+
+One deviation from the reference: the server binds *before* network
+registration so an OS-assigned port (consensus_port = 0, used by tests)
+can be registered with its real value.  With a fixed port the observable
+order matches the reference's gates.
+
+CLI: `python -m consensus_overlord_tpu.service.main run -c config.toml -p
+private_key` (reference README.md:34-43).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+from typing import Optional
+
+from .. import __version__
+from ..crypto.provider import load_private_key
+from ..obs import Metrics, TraceContextInterceptor, init_logging
+from .config import ConsensusConfig
+from .consensus import Consensus
+from .rpc import Code
+from .server import ConsensusServer, build_server
+
+logger = logging.getLogger("consensus_overlord_tpu.main")
+
+
+class ServiceRuntime:
+    """The assembled, running consensus microservice process."""
+
+    def __init__(self, config: ConsensusConfig, private_key: int,
+                 host: str = "[::]"):
+        self.config = config
+        self._private_key = private_key
+        self._host = host
+        self.metrics = (Metrics(config.metrics_buckets)
+                        if config.enable_metrics else None)
+        self.consensus: Optional[Consensus] = None
+        self.bound_port: Optional[int] = None
+        self.metrics_port: Optional[int] = None
+        self._server = None
+        self._tasks: list = []
+        self._stopped = asyncio.Event()
+
+    async def start(self) -> int:
+        """Bring the service up; returns the bound consensus port."""
+        cfg = self.config
+        self.consensus = Consensus(cfg, self._private_key)
+        interceptors = [TraceContextInterceptor()]
+        if self.metrics is not None:
+            interceptors.append(self.metrics.interceptor())
+        self._server, self.bound_port = build_server(
+            ConsensusServer(self.consensus), port=cfg.consensus_port,
+            interceptors=interceptors, host=self._host)
+        await self._server.start()
+        logger.info("grpc server on port %d", self.bound_port)
+
+        # Registration retry loop (reference src/main.rs:186-207).
+        while True:
+            try:
+                code = await self.consensus.network.\
+                    register_network_msg_handler(
+                        "consensus", "localhost", self.bound_port)
+                if code == Code.SUCCESS:
+                    break
+                logger.warning("network registration status %d", code)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("network not ready (%s); retrying", e)
+            await asyncio.sleep(cfg.server_retry_interval)
+        logger.info("registered with network service")
+
+        if self.metrics is not None:
+            self.metrics_port = self.metrics.start_exporter(cfg.metrics_port)
+            logger.info("metrics exporter on port %d", self.metrics_port)
+
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._reconfig_wait_then_run()))
+        return self.bound_port
+
+    async def _reconfig_wait_then_run(self) -> None:
+        """Poll ping_controller until a configuration lands, then run the
+        engine (reference src/main.rs:213-246)."""
+        consensus = self.consensus
+        while consensus.reconfigure is None:
+            await consensus.ping_controller()
+            if consensus.reconfigure is not None:
+                break
+            logger.info("waiting for reconfiguration")
+            await asyncio.sleep(self.config.server_retry_interval)
+        logger.info("start consensus run")
+        await consensus.run()
+
+    async def stop(self, grace: float = 2.0) -> None:
+        if self.consensus is not None:
+            self.consensus.stop()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks.clear()
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+        if self.consensus is not None:
+            await self.consensus.close()
+        if self.metrics is not None:
+            self.metrics.stop_exporter()
+        self._stopped.set()
+
+    async def wait_stopped(self) -> None:
+        await self._stopped.wait()
+
+
+async def run_service(config: ConsensusConfig, private_key: int) -> None:
+    """Run until SIGINT/SIGTERM (the graceful_shutdown hook,
+    reference src/main.rs:167, 272)."""
+    runtime = ServiceRuntime(config, private_key)
+    shutdown = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, shutdown.set)
+        except NotImplementedError:  # pragma: no cover — non-Unix
+            pass
+    await runtime.start()
+    await shutdown.wait()
+    logger.info("shutdown signal received")
+    await runtime.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="consensus",
+        description=f"consensus_overlord_tpu {__version__} — TPU-native "
+                    "consensus microservice (service surface of "
+                    "cita-cloud/consensus_overlord)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    run_p = sub.add_parser("run", help="run the consensus service")
+    run_p.add_argument("-c", "--config", default="config.toml",
+                       help="TOML config path (default: config.toml)")
+    run_p.add_argument("-p", "--private_key_path", default="private_key",
+                       help="hex private-key file (default: private_key)")
+    args = parser.parse_args(argv)
+
+    if args.command == "run":
+        config = ConsensusConfig.load(args.config)
+        init_logging(config.log_config)
+        private_key = load_private_key(args.private_key_path)
+        asyncio.run(run_service(config, private_key))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

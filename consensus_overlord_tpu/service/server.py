@@ -1,0 +1,99 @@
+"""The gRPC server surface: ConsensusService + NetworkMsgHandlerService +
+Health (reference src/main.rs:77-155, src/health_check.rs:22-36), assembled
+into one grpc.aio server (src/main.rs:262-296).
+
+Handlers are thin: gate, decode, forward to the Consensus core, map the
+result to a status code.  Every inbound message's signature work lands on
+the batching frontier inside the core, not here.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import grpc
+
+from .consensus import Consensus
+from .pb import pb2
+from .rpc import (
+    CONSENSUS_SERVICE,
+    HEALTH_SERVICE,
+    NETWORK_MSG_HANDLER_SERVICE,
+    Code,
+    generic_handler,
+)
+
+logger = logging.getLogger("consensus_overlord_tpu.server")
+
+
+class ConsensusServer:
+    """ConsensusService + NetworkMsgHandlerService implementation
+    (reference src/main.rs:77-155)."""
+
+    def __init__(self, consensus: Consensus):
+        self.consensus = consensus
+
+    # -- ConsensusService ---------------------------------------------------
+
+    async def reconfigure(self, request: pb2.ConsensusConfiguration,
+                          context) -> pb2.StatusCode:
+        """Forward to proc_reconfigure; always replies Success — a stale
+        config is ignored, not an error (src/main.rs:92-104)."""
+        self.consensus.proc_reconfigure(request)
+        return pb2.StatusCode(code=Code.SUCCESS)
+
+    async def check_block(self, request: pb2.ProposalWithProof,
+                          context) -> pb2.StatusCode:
+        """NotReady until the first reconfiguration (src/main.rs:112-115),
+        then the full proof audit (src/main.rs:116-123)."""
+        if self.consensus.reconfigure is None:
+            logger.warning("check_block: server not ready")
+            return pb2.StatusCode(code=Code.NOT_READY)
+        ok = self.consensus.check_block(request)
+        return pb2.StatusCode(
+            code=Code.SUCCESS if ok else Code.PROPOSAL_CHECK_ERROR)
+
+    # -- NetworkMsgHandlerService -------------------------------------------
+
+    async def process_network_msg(self, request: pb2.NetworkMsg,
+                                  context) -> pb2.StatusCode:
+        """Reject foreign modules with INVALID_ARGUMENT (src/main.rs:139-142);
+        everything else is decode-verify-inject, always Success (inbound
+        garbage is dropped, never an error to the peer)."""
+        if request.module != "consensus":
+            logger.warning("invalid module %r", request.module)
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "wrong module")
+        await self.consensus.proc_network_msg(request)
+        return pb2.StatusCode(code=Code.SUCCESS)
+
+
+class HealthServer:
+    """Standard health service; unconditionally Serving, like the reference
+    (src/health_check.rs:29-35 — production liveness comes from
+    grpc-health-probe hitting this)."""
+
+    async def check(self, request: pb2.HealthCheckRequest,
+                    context) -> pb2.HealthCheckResponse:
+        return pb2.HealthCheckResponse(
+            status=pb2.HealthCheckResponse.SERVING)
+
+
+def build_server(consensus_server: ConsensusServer,
+                 port: int = 0,
+                 interceptors: Optional[Sequence] = None,
+                 host: str = "[::]") -> tuple[grpc.aio.Server, int]:
+    """Assemble the three services into one grpc.aio server (reference
+    src/main.rs:262-296).  Returns (server, bound_port) — port 0 lets the
+    OS pick (used by tests)."""
+    server = grpc.aio.server(interceptors=list(interceptors or ()))
+    server.add_generic_rpc_handlers((
+        generic_handler("ConsensusService", CONSENSUS_SERVICE,
+                        consensus_server),
+        generic_handler("NetworkMsgHandlerService",
+                        NETWORK_MSG_HANDLER_SERVICE, consensus_server),
+        generic_handler("Health", HEALTH_SERVICE, HealthServer()),
+    ))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    return server, bound

@@ -82,7 +82,7 @@ class SimNode:
                                           frontier_linger_s)
                          if use_frontier else None)
         self.engine = Engine(crypto.pub_key, self.adapter, crypto, self.wal,
-                             inbound_verified=use_frontier)
+                             frontier=self.frontier)
         self.router = router
         self._task: Optional[asyncio.Task] = None
         router.register(crypto.pub_key, self._on_network_msg)
@@ -101,12 +101,7 @@ class SimNode:
             logger.warning("[%s] dropped malformed %s", self.name[:4].hex(),
                            msg_type)
             return
-        if self.frontier is not None:
-            if not await self.frontier.verify_msg(msg):
-                logger.warning("[%s] frontier dropped %s (bad signature)",
-                               self.name[:4].hex(), msg_type)
-                return
-        self.engine.handler.send_msg(msg)
+        await self.engine.inject_inbound(msg)
 
     def start(self, init_height: int, interval_ms: int,
               authority_list: Sequence[Node]) -> None:

@@ -1,0 +1,413 @@
+"""Byzantine-fault tests: adversarial messages injected at the engine
+boundary must never move the state machine.
+
+Each test targets a specific engine guard (VERDICT r1 §weak-5):
+  forged QC signature / tampered voter bitmap / sub-quorum bitmap
+      → Engine._verify_qc (engine/smr.py)
+  equivocating leader, non-leader proposal, bad proposal signature
+      → Engine._on_signed_proposal
+  duplicate-vote replay, forged vote signature, non-validator voter
+      → Engine._on_signed_vote
+plus randomized adversarial message schedules over the sim asserting the
+chain-level fork invariant (SimController raises SafetyViolation on any
+two distinct blocks at one height)."""
+
+import asyncio
+import unittest
+
+from consensus_overlord_tpu.core.bitmap import build_bitmap, extract_voters
+from consensus_overlord_tpu.core.sm3 import sm3_hash
+from consensus_overlord_tpu.core.types import (
+    AggregatedSignature,
+    AggregatedVote,
+    Hash,
+    Node,
+    Proposal,
+    SignedProposal,
+    SignedVote,
+    Vote,
+    VoteType,
+)
+from consensus_overlord_tpu.crypto.provider import Ed25519Crypto
+from consensus_overlord_tpu.engine.smr import Engine
+from consensus_overlord_tpu.engine.wal import MemoryWal
+from consensus_overlord_tpu.sim.harness import SimNetwork
+
+
+def make_cryptos(n=4):
+    return [Ed25519Crypto(i.to_bytes(4, "big") * 8) for i in range(n)]
+
+
+class StubAdapter:
+    """Records every outbound action; commit always 'fails' (returns None)
+    so the engine stays at the height under test."""
+
+    def __init__(self, content=b"block content"):
+        self.content = content
+        self.block_hash = sm3_hash(content)
+        self.commits = []
+        self.broadcasts = []
+        self.transmits = []
+
+    async def get_block(self, height: int):
+        return self.content, self.block_hash
+
+    async def check_block(self, height: int, block_hash: Hash,
+                          content: bytes) -> bool:
+        return True
+
+    async def commit(self, height: int, commit):
+        self.commits.append((height, commit))
+        return None
+
+    async def get_authority_list(self, height: int):
+        return []
+
+    async def broadcast_to_other(self, msg_type: str, payload: bytes):
+        self.broadcasts.append((msg_type, payload))
+
+    async def transmit_to_relayer(self, relayer, msg_type: str,
+                                  payload: bytes):
+        self.transmits.append((bytes(relayer), msg_type, payload))
+
+    def report_error(self, context: str) -> None:
+        pass
+
+    def report_view_change(self, height, round, reason) -> None:
+        pass
+
+
+class EngineHarness:
+    """One engine under test (validator 0 of 4), driven by hand-crafted
+    messages signed with the other validators' real keys."""
+
+    def __init__(self):
+        # The engine under test is the validator with the SMALLEST address
+        # (sorted-authority slot 0), making leadership deterministic:
+        # leader(h, 0) = sorted_slot[h % 4], so the engine follows at
+        # heights 1–3 and leads at height 4.
+        cryptos = make_cryptos(4)
+        cryptos.sort(key=lambda c: c.pub_key)
+        self.cryptos = cryptos
+        self.by_addr = {c.pub_key: c for c in self.cryptos}
+        self.nodes = [Node(c.pub_key) for c in self.cryptos]
+        self.adapter = StubAdapter()
+        self.engine = Engine(self.cryptos[0].pub_key, self.adapter,
+                             self.cryptos[0], MemoryWal())
+
+    async def start(self, height=1):
+        self._task = asyncio.get_running_loop().create_task(
+            self.engine.run(height, 60_000, self.nodes))
+        await asyncio.sleep(0.05)  # let the engine enter the round
+
+    async def settle(self, s=0.1):
+        await asyncio.sleep(s)
+
+    async def stop(self):
+        self.engine.stop()
+        await asyncio.wait_for(self._task, 5)
+
+    # -- crafted messages ---------------------------------------------------
+
+    def leader(self, height, round_=0):
+        return self.engine.leader(height, round_)
+
+    def leader_height(self):
+        """A height whose round-0 leader IS the engine under test."""
+        for height in range(1, 6):
+            if self.leader(height) == self.engine.name:
+                return height
+        raise AssertionError("validator 0 never leads")
+
+    def non_leader_height(self):
+        """A height whose round-0 leader is NOT the engine under test (so
+        crafted foreign proposals are the only proposals in play)."""
+        for height in range(1, 6):
+            if self.leader(height) != self.engine.name:
+                return height
+        raise AssertionError("validator 0 always leads")
+
+    def signed_proposal(self, height, round_=0, content=None, proposer=None,
+                        signer=None, corrupt_sig=False):
+        content = content if content is not None else self.adapter.content
+        proposer = proposer or self.leader(height, round_)
+        signer = signer or proposer
+        p = Proposal(height=height, round=round_, content=content,
+                     block_hash=sm3_hash(content), lock=None,
+                     proposer=proposer)
+        sig = self.by_addr[signer].sign(sm3_hash(p.encode()))
+        if corrupt_sig:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        return SignedProposal(p, sig)
+
+    def signed_vote(self, voter_crypto, height, round_, vote_type,
+                    block_hash, corrupt_sig=False):
+        v = Vote(height, round_, vote_type, block_hash)
+        sig = voter_crypto.sign(sm3_hash(v.encode()))
+        if corrupt_sig:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        return SignedVote(voter_crypto.pub_key, sig, v)
+
+    def qc(self, height, round_, vote_type, block_hash, voters=None,
+           forge_sig=False, bitmap_override=None):
+        """A quorum certificate signed by `voters` (default: validators
+        1..3 — a real quorum without the engine's own key)."""
+        voters = voters if voters is not None else self.cryptos[1:]
+        v = Vote(height, round_, vote_type, block_hash)
+        vote_hash = sm3_hash(v.encode())
+        pairs = sorted((c.pub_key, c.sign(vote_hash)) for c in voters)
+        agg = self.cryptos[0].aggregate_signatures(
+            [s for _, s in pairs], [a for a, _ in pairs])
+        if forge_sig:
+            agg = bytes([agg[0] ^ 1]) + agg[1:]
+        bitmap = (bitmap_override if bitmap_override is not None
+                  else build_bitmap(self.nodes, [a for a, _ in pairs]))
+        return AggregatedVote(
+            signature=AggregatedSignature(agg, bitmap),
+            vote_type=vote_type, height=height, round=round_,
+            block_hash=block_hash, leader=self.leader(height, round_))
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+class TestQCForgery(unittest.TestCase):
+    def test_valid_precommit_qc_commits(self):
+        """Sanity: the attack-free QC drives a commit attempt — so the
+        rejections below demonstrate the guards, not a broken harness."""
+        async def main():
+            h = EngineHarness()
+            await h.start(height=1)
+            h.engine.handler.send_msg(
+                h.signed_proposal(1))  # engine needs the content to commit
+            await h.settle()
+            h.engine.handler.send_msg(
+                h.qc(1, 0, VoteType.PRECOMMIT, h.adapter.block_hash))
+            await h.settle()
+            assert len(h.adapter.commits) == 1
+            await h.stop()
+        run(main())
+
+    def test_forged_qc_signature_rejected(self):
+        async def main():
+            h = EngineHarness()
+            await h.start(height=1)
+            h.engine.handler.send_msg(h.signed_proposal(1))
+            await h.settle()
+            h.engine.handler.send_msg(
+                h.qc(1, 0, VoteType.PRECOMMIT, h.adapter.block_hash,
+                     forge_sig=True))
+            await h.settle()
+            assert h.adapter.commits == []
+            await h.stop()
+        run(main())
+
+    def test_subquorum_bitmap_rejected(self):
+        """A QC naming only 2 of 4 voters (< 2f+1) must be rejected even
+        with valid signatures."""
+        async def main():
+            h = EngineHarness()
+            await h.start(height=1)
+            h.engine.handler.send_msg(h.signed_proposal(1))
+            await h.settle()
+            h.engine.handler.send_msg(
+                h.qc(1, 0, VoteType.PRECOMMIT, h.adapter.block_hash,
+                     voters=h.cryptos[1:3]))
+            await h.settle()
+            assert h.adapter.commits == []
+            await h.stop()
+        run(main())
+
+    def test_tampered_padding_bit_rejected(self):
+        """Setting a padding bit beyond the authority count must invalidate
+        the bitmap (core/bitmap.py hardening): otherwise one aggregated
+        signature would verify under multiple byte-distinct bitmaps."""
+        async def main():
+            h = EngineHarness()
+            await h.start(height=1)
+            h.engine.handler.send_msg(h.signed_proposal(1))
+            await h.settle()
+            good = h.qc(1, 0, VoteType.PRECOMMIT, h.adapter.block_hash)
+            bitmap = bytearray(good.signature.address_bitmap)
+            bitmap[-1] |= 1 << (7 - 4)  # bit index 4: first padding slot
+            with self.assertRaises(ValueError):
+                extract_voters(h.nodes, bytes(bitmap))
+            h.engine.handler.send_msg(h.qc(
+                1, 0, VoteType.PRECOMMIT, h.adapter.block_hash,
+                bitmap_override=bytes(bitmap)))
+            await h.settle()
+            assert h.adapter.commits == []
+            await h.stop()
+        run(main())
+
+    def test_wrong_length_bitmap_rejected(self):
+        async def main():
+            h = EngineHarness()
+            await h.start(height=1)
+            h.engine.handler.send_msg(h.signed_proposal(1))
+            await h.settle()
+            h.engine.handler.send_msg(h.qc(
+                1, 0, VoteType.PRECOMMIT, h.adapter.block_hash,
+                bitmap_override=b"\xe0\x00"))
+            await h.settle()
+            assert h.adapter.commits == []
+            await h.stop()
+        run(main())
+
+
+class TestProposalAttacks(unittest.TestCase):
+    def test_equivocating_leader_second_proposal_ignored(self):
+        """Two distinct proposals for one (height, round) from the leader:
+        only the first is adopted; the equivocation cannot split the
+        engine's prevote."""
+        async def main():
+            h = EngineHarness()
+            await h.start(height=1)
+            a = h.signed_proposal(1, content=b"block A")
+            b = h.signed_proposal(1, content=b"block B")
+            h.engine.handler.send_msg(a)
+            h.engine.handler.send_msg(b)
+            await h.settle()
+            # exactly one prevote cast, for block A
+            votes = [SignedVote.decode(p) for r, t, p in h.adapter.transmits
+                     if t == "SignedVote"]
+            prevotes = [sv for sv in votes
+                        if sv.vote.vote_type == VoteType.PREVOTE]
+            assert len(prevotes) == 1
+            assert prevotes[0].vote.block_hash == sm3_hash(b"block A")
+            await h.stop()
+        run(main())
+
+    def test_non_leader_proposal_ignored(self):
+        async def main():
+            h = EngineHarness()
+            await h.start(height=1)
+            leader = h.leader(1)
+            impostor = next(c.pub_key for c in h.cryptos
+                            if c.pub_key != leader)
+            h.engine.handler.send_msg(
+                h.signed_proposal(1, proposer=impostor, signer=impostor))
+            await h.settle()
+            votes = [SignedVote.decode(p) for r, t, p in h.adapter.transmits
+                     if t == "SignedVote"]
+            assert all(sv.vote.block_hash != h.adapter.block_hash
+                       for sv in votes)
+            await h.stop()
+        run(main())
+
+    def test_bad_proposal_signature_ignored(self):
+        async def main():
+            h = EngineHarness()
+            await h.start(height=1)
+            h.engine.handler.send_msg(h.signed_proposal(1, corrupt_sig=True))
+            await h.settle()
+            votes = [SignedVote.decode(p) for r, t, p in h.adapter.transmits
+                     if t == "SignedVote"]
+            assert all(sv.vote.block_hash != h.adapter.block_hash
+                       for sv in votes)
+            await h.stop()
+        run(main())
+
+
+class TestVoteAttacks(unittest.TestCase):
+    """Attacks on the leader's vote-collection path.  Height 4 makes the
+    harness engine (sorted slot 0) the round-0 leader; as leader it
+    proposes and self-delivers its OWN prevote, so the quorum of 3 needs
+    two more distinct voters."""
+
+    LEADER_HEIGHT = 4
+
+    def test_duplicate_vote_replay_not_counted(self):
+        """One distinct foreign voter plus replays of the same vote is 2 of
+        the 3 needed — no QC; a second distinct voter completes it."""
+        async def main():
+            h = EngineHarness()
+            height = self.LEADER_HEIGHT
+            await h.start(height=height)
+            await h.settle()
+            bh = h.adapter.block_hash
+            v1 = h.signed_vote(h.cryptos[1], height, 0, VoteType.PREVOTE, bh)
+            for sv in (v1, v1, v1):
+                h.engine.handler.send_msg(sv)
+            await h.settle()
+            qcs = [t for t, p in h.adapter.broadcasts
+                   if t == "AggregatedVote"]
+            assert qcs == [], "replayed votes must not reach quorum"
+            # a second distinct voter completes the quorum
+            h.engine.handler.send_msg(
+                h.signed_vote(h.cryptos[2], height, 0, VoteType.PREVOTE, bh))
+            await h.settle()
+            qcs = [t for t, p in h.adapter.broadcasts
+                   if t == "AggregatedVote"]
+            assert len(qcs) >= 1
+            await h.stop()
+        run(main())
+
+    def test_forged_vote_signature_not_counted(self):
+        async def main():
+            h = EngineHarness()
+            height = self.LEADER_HEIGHT
+            await h.start(height=height)
+            await h.settle()
+            bh = h.adapter.block_hash
+            h.engine.handler.send_msg(
+                h.signed_vote(h.cryptos[1], height, 0, VoteType.PREVOTE, bh))
+            h.engine.handler.send_msg(
+                h.signed_vote(h.cryptos[2], height, 0, VoteType.PREVOTE, bh,
+                              corrupt_sig=True))
+            h.engine.handler.send_msg(
+                h.signed_vote(h.cryptos[3], height, 0, VoteType.PREVOTE, bh,
+                              corrupt_sig=True))
+            await h.settle()
+            qcs = [t for t, p in h.adapter.broadcasts
+                   if t == "AggregatedVote"]
+            assert qcs == [], "forged votes must not reach quorum"
+            await h.stop()
+        run(main())
+
+    def test_non_validator_vote_ignored(self):
+        async def main():
+            h = EngineHarness()
+            height = self.LEADER_HEIGHT
+            await h.start(height=height)
+            await h.settle()
+            bh = h.adapter.block_hash
+            outsider = Ed25519Crypto(b"\x77" * 32)
+            h.engine.handler.send_msg(
+                h.signed_vote(h.cryptos[1], height, 0, VoteType.PREVOTE, bh))
+            h.engine.handler.send_msg(
+                h.signed_vote(h.cryptos[2], height, 0, VoteType.PREVOTE, bh))
+            h.engine.handler.send_msg(
+                h.signed_vote(outsider, height, 0, VoteType.PREVOTE, bh))
+            await h.settle()
+            qcs = [t for t, p in h.adapter.broadcasts
+                   if t == "AggregatedVote"]
+            assert qcs == [], "an outsider vote must not complete a quorum"
+            await h.stop()
+        run(main())
+
+
+class TestRandomizedSchedules(unittest.TestCase):
+    def test_fork_invariant_under_adversarial_network(self):
+        """Randomized drop/delay schedules: the run may be slow but never
+        forks (SimController raises SafetyViolation on any conflicting
+        commit) and must stay live enough to reach height 2."""
+        async def one(seed):
+            net = SimNetwork(4, block_interval_ms=50, seed=seed,
+                             drop_rate=0.15, delay_range=(0.0, 0.08))
+            net.start()
+            try:
+                await net.run_until_height(2, timeout=60.0)
+            finally:
+                await net.stop()
+
+        async def main():
+            for seed in (11, 29, 43):
+                await one(seed)
+
+        run(main())
+
+
+if __name__ == "__main__":
+    unittest.main()

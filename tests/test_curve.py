@@ -151,3 +151,59 @@ class TestG2:
         for p in pts:
             want = oracle.g2_add(want, p)
         assert got == want
+
+
+class TestEndomorphismSubgroupChecks:
+    """The fast φ/ψ membership criteria must agree with the naive
+    [r]P == 𝒪 semantics on members, cofactor points, and infinity."""
+
+    def _g1_cofactor_point(self):
+        x = 2
+        while True:
+            y = oracle.fq_sqrt((x**3 + 4) % oracle.P)
+            if y is not None and not oracle.g1_in_subgroup((x, y)):
+                return (x, y)
+            x += 1
+
+    def _g2_cofactor_point(self):
+        i = 1
+        while True:
+            x = (i, i + 1)
+            rhs = oracle.fq2_add(
+                oracle.fq2_mul(oracle.fq2_sq(x), x), (4, 4))
+            y = oracle.fq2_sqrt(rhs)
+            if y is not None and not oracle.g2_in_subgroup((x, y)):
+                return (x, y)
+            i += 1
+
+    def test_g1_fast_vs_full(self):
+        from consensus_overlord_tpu.ops.bls12381_groups import (
+            g1_in_subgroup_full)
+        batch = g1_from_oracle(rand_g1(2) + [self._g1_cofactor_point(), None])
+        fast = list(np.asarray(g1_in_subgroup(batch)))
+        full = list(np.asarray(g1_in_subgroup_full(batch)))
+        assert fast == full == [True, True, False, True]
+
+    def test_g2_fast_vs_full(self):
+        from consensus_overlord_tpu.ops.bls12381_groups import (
+            g2_in_subgroup_full)
+        batch = g2_from_oracle(rand_g2(2) + [self._g2_cofactor_point(), None])
+        fast = list(np.asarray(g2_in_subgroup(batch)))
+        full = list(np.asarray(g2_in_subgroup_full(batch)))
+        assert fast == full == [True, True, False, True]
+
+    def test_endomorphism_constants_vs_oracle(self):
+        """β acts as λ = −z² on G1; ψ acts as z on G2 (host-side check of
+        the embedded constants against the oracle)."""
+        from consensus_overlord_tpu.ops.bls12381_groups import (
+            _BETA_INT, _PSI_CX_INT, _PSI_CY_INT, Z_ABS)
+        z = -Z_ABS
+        assert (z**4 - z**2 + 1) == oracle.R
+        lam = (-z * z) % oracle.R
+        assert (lam * lam + lam + 1) % oracle.R == 0
+        g = oracle.G1_GEN
+        assert ((g[0] * _BETA_INT) % oracle.P, g[1]) == oracle.g1_mul(g, lam)
+        q = oracle.G2_GEN
+        psi_q = (oracle.fq2_mul(oracle.fq2_conj(q[0]), _PSI_CX_INT),
+                 oracle.fq2_mul(oracle.fq2_conj(q[1]), _PSI_CY_INT))
+        assert psi_q == oracle.g2_mul(q, z % oracle.R)

@@ -1,0 +1,174 @@
+"""Service-process integration: the real gRPC microservice booted against
+in-process fake network/controller siblings (reference deployment shape,
+SURVEY.md §4) — registration retry, ping_controller bootstrap, NetworkMsg
+push delivery, commits end-to-end, proof audit over RPC, NotReady gate,
+module guard, health, metrics."""
+
+import asyncio
+import tempfile
+import unittest
+import urllib.request
+
+import grpc
+
+from consensus_overlord_tpu.crypto.provider import CpuBlsCrypto
+from consensus_overlord_tpu.service.config import ConsensusConfig
+from consensus_overlord_tpu.service.main import ServiceRuntime
+from consensus_overlord_tpu.service.pb import pb2
+from consensus_overlord_tpu.service.rpc import (
+    CONSENSUS_SERVICE,
+    HEALTH_SERVICE,
+    NETWORK_MSG_HANDLER_SERVICE,
+    Code,
+    RetryClient,
+)
+from consensus_overlord_tpu.sim.grpc_fakes import (
+    FakeController,
+    NetworkFabric,
+    start_fake_controller,
+    start_fake_network,
+)
+
+N_NODES = 4
+KEYS = [0x5EED + 31 * i for i in range(N_NODES)]
+
+
+class ServiceEndToEnd(unittest.TestCase):
+    def test_four_node_grpc_consensus(self):
+        """Four ServiceRuntimes + four fake network siblings + one fake
+        controller commit blocks over real gRPC, then the committed proof
+        passes CheckBlock and a tampered one fails."""
+
+        async def main():
+            cryptos = [CpuBlsCrypto(k) for k in KEYS]
+            validators = [c.pub_key for c in cryptos]
+            fabric = NetworkFabric()
+            fabric.set_validators(validators)
+            # interval 2 s: round timers scale off it, and pure-Python BLS
+            # on the 1-core CI box needs the headroom to beat the timeouts
+            controller = FakeController(validators, block_interval=2)
+            ctrl_server, ctrl_port = await start_fake_controller(controller)
+            net_servers = []
+            runtimes = []
+            tmp = tempfile.TemporaryDirectory()
+            try:
+                for i in range(N_NODES):
+                    net_server, net_port = await start_fake_network(fabric, i)
+                    net_servers.append(net_server)
+                    config = ConsensusConfig(
+                        network_port=net_port,
+                        consensus_port=0,           # OS-assigned
+                        controller_port=ctrl_port,
+                        server_retry_interval=1,
+                        wal_path=f"{tmp.name}/wal{i}",
+                        enable_metrics=(i == 0),
+                        metrics_port=0,
+                        crypto_backend="cpu")
+                    rt = ServiceRuntime(config, KEYS[i], host="localhost")
+                    port = await rt.start()
+                    controller.consensus_addrs.append(f"localhost:{port}")
+                    runtimes.append(rt)
+
+                await controller.wait_for_height(2, timeout=120)
+
+                # -- proof audit over RPC (reference src/main.rs:107-127) --
+                h = 1
+                client = RetryClient(
+                    f"localhost:{runtimes[0].bound_port}",
+                    "ConsensusService", CONSENSUS_SERVICE, retries=1)
+                good = pb2.ProposalWithProof(
+                    proposal=pb2.Proposal(height=h, data=controller.chain[h]),
+                    proof=controller.proofs[h])
+                resp = await client.call("CheckBlock", good)
+                self.assertEqual(resp.code, Code.SUCCESS)
+                bad = pb2.ProposalWithProof(
+                    proposal=pb2.Proposal(height=h,
+                                          data=controller.chain[h] + b"x"),
+                    proof=controller.proofs[h])
+                resp = await client.call("CheckBlock", bad)
+                self.assertEqual(resp.code, Code.PROPOSAL_CHECK_ERROR)
+                await client.close()
+
+                # -- metrics exporter serves the RPC histogram -------------
+                port = runtimes[0].metrics_port
+                self.assertIsNotNone(port)
+                body = await asyncio.to_thread(
+                    lambda: urllib.request.urlopen(
+                        f"http://localhost:{port}/metrics", timeout=5).read())
+                self.assertIn(b"grpc_server_handling_ms", body)
+                self.assertIn(b"ProcessNetworkMsg", body)
+
+                # every node's frontier actually batched signatures
+                stats = [rt.consensus.frontier.stats for rt in runtimes]
+                self.assertTrue(any(s.batches > 0 for s in stats))
+            finally:
+                for rt in runtimes:
+                    await rt.stop()
+                for s in net_servers:
+                    await s.stop(0.5)
+                await ctrl_server.stop(0.5)
+                await controller.close()
+                await fabric.close()
+                tmp.cleanup()
+
+        asyncio.run(main())
+
+    def test_not_ready_module_guard_health(self):
+        """Before any reconfiguration: CheckBlock → NOT_READY; foreign
+        module → INVALID_ARGUMENT; Health → SERVING
+        (reference src/main.rs:112-115, 139-142; health_check.rs:29-35)."""
+
+        async def main():
+            fabric = NetworkFabric()
+            controller = FakeController([], block_interval=1)
+            net_server, net_port = await start_fake_network(fabric, 0)
+            tmp = tempfile.TemporaryDirectory()
+            config = ConsensusConfig(
+                network_port=net_port, consensus_port=0,
+                controller_port=1,  # nothing listens: stays NotReady
+                server_retry_interval=1, wal_path=f"{tmp.name}/wal",
+                enable_metrics=False, crypto_backend="cpu")
+            rt = ServiceRuntime(config, 0xABCDEF, host="localhost")
+            try:
+                port = await rt.start()
+                addr = f"localhost:{port}"
+
+                cons = RetryClient(addr, "ConsensusService",
+                                   CONSENSUS_SERVICE, retries=1)
+                resp = await cons.call("CheckBlock", pb2.ProposalWithProof(
+                    proposal=pb2.Proposal(height=1, data=b"x"), proof=b""))
+                self.assertEqual(resp.code, Code.NOT_READY)
+                await cons.close()
+
+                net = RetryClient(addr, "NetworkMsgHandlerService",
+                                  NETWORK_MSG_HANDLER_SERVICE, retries=1)
+                with self.assertRaises(grpc.aio.AioRpcError) as ctx:
+                    await net.call("ProcessNetworkMsg", pb2.NetworkMsg(
+                        module="storage", type="SignedVote", msg=b""))
+                self.assertEqual(ctx.exception.code(),
+                                 grpc.StatusCode.INVALID_ARGUMENT)
+                # valid module + garbage payload: logged-and-dropped Success
+                resp = await net.call("ProcessNetworkMsg", pb2.NetworkMsg(
+                    module="consensus", type="SignedVote", msg=b"\xff\xff"))
+                self.assertEqual(resp.code, Code.SUCCESS)
+                await net.close()
+
+                health = RetryClient(addr, "Health", HEALTH_SERVICE,
+                                     retries=1)
+                resp = await health.call(
+                    "Check", pb2.HealthCheckRequest(service=""))
+                self.assertEqual(resp.status,
+                                 pb2.HealthCheckResponse.SERVING)
+                await health.close()
+            finally:
+                await rt.stop()
+                await net_server.stop(0.5)
+                await controller.close()
+                await fabric.close()
+                tmp.cleanup()
+
+        asyncio.run(main())
+
+
+if __name__ == "__main__":
+    unittest.main()
