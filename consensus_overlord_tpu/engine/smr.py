@@ -406,14 +406,22 @@ class Engine:
         logger.debug("%s: enter round %d (leader=%s)", self._tag(), round_,
                      self.leader(self.height, round_)[:4].hex())
         if self.leader(self.height, round_) == self.name:
-            self._spawn(self._propose())
+            # Pass the position explicitly: the task body may start only
+            # after a choke QC has already advanced the round, and reading
+            # self.round then would propose at a round we don't lead.
+            self._spawn(self._propose(self.height, round_))
         self._set_timer(Step.PROPOSE, self.timer_config.propose_ratio)
         self._drain_pending()
 
     # -- timers ------------------------------------------------------------
 
     def _set_timer(self, step: Step, ratio: int) -> None:
-        delay = self.interval_ms * ratio / 10 / 1000.0
+        # Tendermint liveness: timeouts must eventually exceed the real
+        # network delay, or every round nil-precommits before the polka
+        # lands.  Grow linearly with the round, capped so late rounds stay
+        # responsive (timeout(r) = base * (1 + r/2), cap 16x).
+        backoff = min(1.0 + 0.5 * self.round, 16.0)
+        delay = self.interval_ms * ratio / 10 / 1000.0 * backoff
         prev = self._timers.pop(step, None)
         if prev is not None:
             prev.cancel()
@@ -434,9 +442,10 @@ class Engine:
 
     # -- proposing ---------------------------------------------------------
 
-    async def _propose(self) -> None:
+    async def _propose(self, height: int, round_: int) -> None:
         """Leader path: fetch (or re-propose locked) content, then broadcast."""
-        height, round_ = self.height, self.round
+        if height != self.height or round_ != self.round:
+            return
         if round_ == 0 and self._last_commit_ts > 0:
             # Pace block production by the configured interval (the engine's
             # `interval` semantics, reference src/consensus.rs:110, 117, 633).

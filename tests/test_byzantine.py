@@ -373,11 +373,12 @@ class TestVoteAttacks(unittest.TestCase):
             await h.start(height=height)
             await h.settle()
             bh = h.adapter.block_hash
+            # The engine (leader) votes for itself, so only ONE more valid
+            # vote may arrive: self + cryptos[1] + outsider = quorum iff the
+            # outsider's (validly self-signed) vote is wrongly counted.
             outsider = Ed25519Crypto(b"\x77" * 32)
             h.engine.handler.send_msg(
                 h.signed_vote(h.cryptos[1], height, 0, VoteType.PREVOTE, bh))
-            h.engine.handler.send_msg(
-                h.signed_vote(h.cryptos[2], height, 0, VoteType.PREVOTE, bh))
             h.engine.handler.send_msg(
                 h.signed_vote(outsider, height, 0, VoteType.PREVOTE, bh))
             await h.settle()
