@@ -1,0 +1,30 @@
+# Two-stage image for the consensus microservice (the TPU-native analog of
+# reference Dockerfile:1-17: slim runtime, non-root user, health probe).
+#
+# The runtime stage carries CPU jax only — the image is the CITA-Cloud
+# process shell; on TPU hosts, mount the libtpu wheel or swap the base for
+# a TPU-enabled one and the provider picks the device up automatically.
+FROM python:3.11-slim AS build
+WORKDIR /build
+COPY consensus_overlord_tpu/ consensus_overlord_tpu/
+COPY protos/ protos/
+COPY setup.py README.md ./
+RUN pip install --no-cache-dir build && python -m build --wheel
+
+FROM python:3.11-slim
+RUN useradd -m chain
+WORKDIR /home/chain
+COPY --from=build /build/dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl "jax[cpu]" grpcio protobuf \
+        prometheus-client && rm /tmp/*.whl
+# grpc health probing (reference Dockerfile:16) — the Health service is
+# standard, so any grpc-health-probe binary works; ship a python probe so
+# the image stays single-arch-independent.
+COPY docker/health_probe.py /usr/local/bin/health_probe
+USER chain
+ENV PYTHONUNBUFFERED=1
+# package dir is root-owned system site-packages; keep the XLA compile
+# cache somewhere the runtime user can write
+ENV CONSENSUS_JAX_CACHE=/home/chain/.jax_cache
+ENTRYPOINT ["python", "-m", "consensus_overlord_tpu.service.main"]
+CMD ["run", "-c", "config.toml", "-p", "private_key"]

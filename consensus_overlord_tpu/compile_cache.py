@@ -1,0 +1,35 @@
+"""Persistent XLA compilation cache, shared by every entry point.
+
+The batched big-field kernels are large graphs (hundreds of field ops,
+multi-hundred-iteration scans); a cold compile of the full provider kernel
+set costs minutes, a cache hit costs milliseconds.  Every process that may
+touch the device kernels (service, sim CLI, bench, driver entry points,
+tests) funnels through enable() so one machine compiles each (kernel,
+shape, backend) exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+
+
+def enable(cache_dir: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at `cache_dir` (default:
+    <repo>/.jax_cache, overridable via CONSENSUS_JAX_CACHE).  Safe to call
+    any time — before or after backend init — and idempotent."""
+    import jax
+
+    path = (cache_dir or os.environ.get("CONSENSUS_JAX_CACHE")
+            or _DEFAULT_DIR)
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        # Read-only install (e.g. system site-packages under a non-root
+        # runtime user): run without a persistent cache rather than crash.
+        return ""
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return path

@@ -1,0 +1,176 @@
+"""ctypes bridge to the native BLS12-381 backend (csrc/bls381.c).
+
+The shared object is built on demand with the system compiler (the build
+environment ships g++/cc but no pybind11; ctypes keeps the binding layer
+dependency-free).  If no compiler is available the import still succeeds
+with ``AVAILABLE = False`` and callers fall back to the pure-Python oracle
+— the native path is an accelerator, never a requirement.
+
+Layout conventions (must match csrc/bls381.c):
+  Fp          6 x u64 little-endian canonical limbs
+  G1 affine   12 u64 (x, y);  all-zero = point at infinity
+  G2 affine   24 u64 (x.c0, x.c1, y.c0, y.c1); all-zero = infinity
+  Fp12        72 u64, (c0.a0.c0, c0.a0.c1, c0.a1.c0, ... c1.a2.c1)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("consensus_overlord_tpu.native")
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO_PATH = os.path.join(_CSRC, "_bls381.so")
+_SRC_PATH = os.path.join(_CSRC, "bls381.c")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False  # memoized: never retry a failed build per process
+AVAILABLE = False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC_PATH):
+        return False
+    src_mtime = os.path.getmtime(_SRC_PATH)
+    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= src_mtime:
+        return True
+    # Compile to a temp path and rename into place: concurrent processes
+    # sharing a checkout must never dlopen a half-written .so.
+    tmp_path = f"{_SO_PATH}.{os.getpid()}.tmp"
+    for cc in ("cc", "gcc", "g++", "clang"):
+        try:
+            subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-o", tmp_path, _SRC_PATH],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp_path, _SO_PATH)
+            return True
+        except (FileNotFoundError, subprocess.CalledProcessError,
+                subprocess.TimeoutExpired, OSError) as e:
+            logger.debug("native build with %s failed: %s", cc, e)
+    try:
+        os.unlink(tmp_path)
+    except OSError:
+        pass
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed, AVAILABLE
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if not _build():
+            _load_failed = True
+            logger.info("native BLS backend unavailable; "
+                        "using the pure-Python pairing path")
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:  # pragma: no cover
+            logger.warning("native BLS backend failed to load: %s", e)
+            _load_failed = True
+            return None
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.bls381_multi_pairing_is_one.restype = ctypes.c_int
+        lib.bls381_multi_pairing_is_one.argtypes = [u64p, u64p,
+                                                    ctypes.c_int32]
+        for name in ("bls381_pairing", "bls381_miller"):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [u64p, u64p, u64p]
+        lib.bls381_final_exp.restype = None
+        lib.bls381_final_exp.argtypes = [u64p, u64p]
+        _lib = lib
+        AVAILABLE = True
+        return lib
+
+
+def _fp_limbs(v: int) -> List[int]:
+    return [(v >> (64 * i)) & 0xFFFFFFFFFFFFFFFF for i in range(6)]
+
+
+def _limbs_to_int(limbs: Sequence[int]) -> int:
+    out = 0
+    for i, l in enumerate(limbs):
+        out |= int(l) << (64 * i)
+    return out
+
+
+def _pack_g1(pt) -> List[int]:
+    if pt is None:
+        return [0] * 12
+    x, y = pt
+    return _fp_limbs(x) + _fp_limbs(y)
+
+
+def _pack_g2(pt) -> List[int]:
+    if pt is None:
+        return [0] * 24
+    (x0, x1), (y0, y1) = pt
+    return _fp_limbs(x0) + _fp_limbs(x1) + _fp_limbs(y0) + _fp_limbs(y1)
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def multi_pairing_is_one(pairs: Iterable[Tuple[object, object]]) -> bool:
+    """Native Π e(P_i, Q_i) == 1 over oracle-format affine points
+    (ints for G1, int-pairs for G2; None = infinity).  Raises
+    RuntimeError if the backend is unavailable — call available() first
+    or use crypto.backend which handles the fallback."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native BLS backend unavailable")
+    g1s: List[int] = []
+    g2s: List[int] = []
+    k = 0
+    for p, q in pairs:
+        g1s.extend(_pack_g1(p))
+        g2s.extend(_pack_g2(q))
+        k += 1
+    if k == 0:
+        return True
+    a1 = (ctypes.c_uint64 * len(g1s))(*g1s)
+    a2 = (ctypes.c_uint64 * len(g2s))(*g2s)
+    return bool(lib.bls381_multi_pairing_is_one(a1, a2, k))
+
+
+def _fp12_out_to_tuple(out) -> tuple:
+    vals = [_limbs_to_int(out[i * 6:(i + 1) * 6]) for i in range(12)]
+    def fq2(i):
+        return (vals[i], vals[i + 1])
+    return (((fq2(0)), (fq2(2)), (fq2(4))), ((fq2(6)), (fq2(8)), (fq2(10))))
+
+
+def pairing(p, q) -> tuple:
+    """e(P, Q)^3 (the oracle's cubed convention) as an oracle-format Fq12
+    tuple — used by the cross-validation tests."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native BLS backend unavailable")
+    a1 = (ctypes.c_uint64 * 12)(*_pack_g1(p))
+    a2 = (ctypes.c_uint64 * 24)(*_pack_g2(q))
+    out = (ctypes.c_uint64 * 72)()
+    lib.bls381_pairing(a1, a2, out)
+    return _fp12_out_to_tuple(list(out))
+
+
+def miller(p, q) -> tuple:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native BLS backend unavailable")
+    a1 = (ctypes.c_uint64 * 12)(*_pack_g1(p))
+    a2 = (ctypes.c_uint64 * 24)(*_pack_g2(q))
+    out = (ctypes.c_uint64 * 72)()
+    lib.bls381_miller(a1, a2, out)
+    return _fp12_out_to_tuple(list(out))

@@ -37,7 +37,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..compile_cache import enable as _enable_compile_cache
 from ..core.sm3 import sm3_hash
+
+# The provider's kernels are the big compiles; make sure every process
+# that imports them shares the machine-wide persistent cache.
+_enable_compile_cache()
 from ..ops import bls12381_groups as dev
 from ..ops.curve import Point
 from . import bls12381 as oracle

@@ -120,16 +120,13 @@ class CurveOps:
     # -- scalar multiplication ----------------------------------------------
 
     def scalar_mul_static(self, p: Point, k: int) -> Point:
-        """p·k for a static Python-int scalar.  Two compilation strategies
-        by bit density:
-
-        * sparse (e.g. |z| = 0xd201000000010000, Hamming weight 6 — the
-          scalar of the fast subgroup checks): runs of doublings as
-          lax.scan segments with an unconditional add only at each set
-          bit — 63 doubles + 5 adds instead of 63 double-AND-adds;
-        * dense (e.g. the full group order): one uniform
-          double-and-select-add scan, keeping the XLA graph compact.
-        """
+        """p·k for a static Python-int scalar, as one uniform
+        double-and-select-add lax.scan.  (A "sparse" ladder that unrolls
+        doubling runs between set bits looks cheaper on paper — 5 adds for
+        |z| instead of 63 selects — but every unrolled point op is ~1k HLO
+        ops, so it traded a few device selects for a 40s trace+compile per
+        use site.  One scan body keeps the graph compact; the scan
+        dominates runtime either way.)"""
         if k < 0:
             return self.scalar_mul_static(self.neg(p), -k)
         if k == 0:
@@ -137,8 +134,6 @@ class CurveOps:
         bits = [int(c) for c in bin(k)[3:]]
         if not bits:
             return p
-        if sum(bits) * 4 <= len(bits):
-            return self._scalar_mul_sparse(p, bits)
         return self._scalar_mul_dense(p, bits)
 
     def _scalar_mul_dense(self, p: Point, bits: Sequence[int]) -> Point:
@@ -153,30 +148,6 @@ class CurveOps:
 
         acc, _ = lax.scan(step, acc, jnp.asarray(list(bits), jnp.int32))
         return acc
-
-    def _scalar_mul_sparse(self, p: Point, bits: Sequence[int]) -> Point:
-        def run_doubles(acc: Point, count: int) -> Point:
-            if count == 0:
-                return acc
-            if count <= 2:
-                for _ in range(count):
-                    acc = self.add(acc, acc)
-                return acc
-
-            def body(a, _):
-                return self.add(a, a), None
-
-            return lax.scan(body, acc, None, length=count)[0]
-
-        acc = p
-        run = 0
-        for bit in bits:
-            run += 1
-            if bit:
-                acc = run_doubles(acc, run)
-                run = 0
-                acc = self.add(acc, p)
-        return run_doubles(acc, run)
 
     def _coord_rank(self) -> int:
         """Number of trailing field axes in a coordinate array (1 for Fq,

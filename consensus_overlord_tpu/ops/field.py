@@ -225,10 +225,17 @@ class FieldSpec:
 
     def mul(self, x: Array, y: Array) -> Array:
         n = self.n
-        shape = jnp.broadcast_shapes(x.shape[:-1], y.shape[:-1])
-        out = jnp.zeros(shape + (2 * n - 1,), jnp.int32)
-        for i in range(n):
-            out = out.at[..., i:i + n].add(x[..., i:i + 1] * y)
+        # Product convolution as shifted adds, NOT in-place slice updates:
+        # n chained .at[].add updates serialize the graph and blow XLA
+        # compile time up ~50x per mul; n static pads reassociate freely.
+        terms = [
+            jnp.pad(x[..., i:i + 1] * y,
+                    [(0, 0)] * (max(x.ndim, y.ndim) - 1) + [(i, n - 1 - i)])
+            for i in range(n)
+        ]
+        out = terms[0]
+        for t in terms[1:]:
+            out = out + t
         return self._reduce(out, self._conv_bounds())
 
     def sq(self, x: Array) -> Array:

@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""grpc-health-probe equivalent (reference Dockerfile:16): exit 0 iff the
+consensus service's Health.check answers SERVING."""
+import sys
+
+import grpc
+
+from consensus_overlord_tpu.service.pb import pb2  # noqa: E402
+
+
+def main() -> int:
+    addr = sys.argv[1] if len(sys.argv) > 1 else "localhost:50001"
+    channel = grpc.insecure_channel(addr)
+    stub = channel.unary_unary(
+        "/consensus_overlord_tpu.Health/Check",
+        request_serializer=pb2.HealthCheckRequest.SerializeToString,
+        response_deserializer=pb2.HealthCheckResponse.FromString)
+    try:
+        resp = stub(pb2.HealthCheckRequest(), timeout=3)
+    except grpc.RpcError as e:
+        print(f"probe failed: {e.code()}", file=sys.stderr)
+        return 1
+    ok = resp.status == pb2.HealthCheckResponse.SERVING
+    print("SERVING" if ok else "NOT_SERVING")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
