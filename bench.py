@@ -5,10 +5,16 @@ consensus round (reference src/consensus.rs:397-416 does this one
 signature at a time in native CPU code):
 
   host parse → device decompress+subgroup+RLC-MSM (G1 over signatures,
-  G2 over cached pubkeys) → host pairing check (2 pairings, O(1)).
+  G2 over cached pubkeys) → native host pairing check (2 pairings, O(1)).
 
-Baseline = the host CPU oracle verifying one signature at a time
-(the single-thread blst-equivalent posture of BASELINE.md config 1).
+Baseline (the `vs_baseline` denominator): **1,400 verifies/s/core**, the
+blst-equivalent single-thread CPU rate BASELINE.md names as the bar (a
+native blst verify costs ~0.7 ms on a modern x86 core; the reference's
+ophelia-blst path is exactly that).  The repo's own CPU paths are also
+measured and reported on stderr for context:
+  - cpu_native: oracle verify with the csrc/bls381.c pairing backend
+  - cpu_python: the pure-Python oracle (the round-1 strawman — kept so
+    the inflation of comparing against it stays visible)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -24,6 +30,11 @@ N = int(os.environ.get("BENCH_N", "1024"))       # votes per round-batch
 ITERS = int(os.environ.get("BENCH_ITERS", "4"))  # timed iterations
 CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".bench_fixture.npz")
+
+#: BASELINE.md "blst-equivalent single-thread verify rate" — the honest
+#: external bar (round 1 compared against the pure-Python oracle, which
+#: inflated the ratio ~200x; see ADVICE.md r1).
+BLST_EQUIV_CPU_RATE = 1400.0
 
 
 def _fixture():
@@ -51,16 +62,11 @@ def _fixture():
 
 
 def main():
-    # Persistent compilation cache: the big kernels compile once per
-    # machine, not once per bench run.
-    import jax
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from consensus_overlord_tpu.compile_cache import enable
+    enable()
 
     from consensus_overlord_tpu.crypto import bls12381 as oracle
+    from consensus_overlord_tpu.crypto import native
     from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
 
     sigs, h, pks = _fixture()
@@ -79,18 +85,37 @@ def main():
     elapsed = time.time() - t0
     rate = N * ITERS / elapsed
 
-    # Baseline: host oracle, one signature at a time (single-thread CPU).
+    # Context rates (stderr): this repo's own CPU paths, single thread.
     k = 8
     t0 = time.time()
     for i in range(k):
         assert oracle.verify(pks[i], h, sigs[i])
-    cpu_rate = k / (time.time() - t0)
+    cpu_best = k / (time.time() - t0)
+    cpu_key = ("cpu_native_verifies_per_s" if native.available()
+               else "cpu_pure_python_verifies_per_s")
+    pure = None
+    if native.available():
+        sig_pt = oracle.g1_decompress(sigs[0])
+        pk_pt = oracle.g2_decompress(pks[0])
+        h_pt = oracle.hash_to_g1(h, b"")
+        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+        t0 = time.time()
+        oracle.multi_pairing_is_one_pure([(sig_pt, neg_g2), (h_pt, pk_pt)])
+        pure = 1 / (time.time() - t0)
+    print(json.dumps({
+        "context": {
+            "batch": N, "iters": ITERS,
+            cpu_key: round(cpu_best, 2),
+            "cpu_pure_python_pairings_per_s":
+                round(pure, 2) if pure else None,
+            "blst_equiv_baseline_per_s": BLST_EQUIV_CPU_RATE,
+        }}), file=sys.stderr)
 
     print(json.dumps({
         "metric": "bls12381_sig_verifies_per_sec_per_chip",
         "value": round(rate, 2),
         "unit": "verifies/s",
-        "vs_baseline": round(rate / cpu_rate, 2),
+        "vs_baseline": round(rate / BLST_EQUIV_CPU_RATE, 2),
     }))
 
 

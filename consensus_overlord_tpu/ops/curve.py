@@ -120,53 +120,84 @@ class CurveOps:
     # -- scalar multiplication ----------------------------------------------
 
     def scalar_mul_static(self, p: Point, k: int) -> Point:
-        """p·k for a static Python-int scalar, as one uniform
-        double-and-select-add lax.scan.  (A "sparse" ladder that unrolls
-        doubling runs between set bits looks cheaper on paper — 5 adds for
-        |z| instead of 63 selects — but every unrolled point op is ~1k HLO
-        ops, so it traded a few device selects for a 40s trace+compile per
-        use site.  One scan body keeps the graph compact; the scan
-        dominates runtime either way.)"""
+        """p·k for a static Python-int scalar, through the same windowed
+        scan as the per-lane path (bits broadcast across the batch).  (A
+        "sparse" ladder that unrolls doubling runs between set bits looks
+        cheaper on paper, but every unrolled point op is ~1k HLO ops, so
+        it traded a few device selects for a 40s trace+compile per use
+        site.  One scan body keeps the graph compact.)"""
         if k < 0:
             return self.scalar_mul_static(self.neg(p), -k)
         if k == 0:
             return self.infinity_like(p.x)
-        bits = [int(c) for c in bin(k)[3:]]
-        if not bits:
-            return p
-        return self._scalar_mul_dense(p, bits)
-
-    def _scalar_mul_dense(self, p: Point, bits: Sequence[int]) -> Point:
-        acc = p
+        bits = [int(c) for c in bin(k)[2:]]
+        window = 4
+        bits = [0] * ((-len(bits)) % window) + bits
         batch_rank = p.x.ndim - self._coord_rank()
+        batch_shape = p.x.shape[:batch_rank]
+        barr = jnp.broadcast_to(jnp.asarray(bits, jnp.int32),
+                                batch_shape + (len(bits),))
+        return self.scalar_mul_bits(p, barr, window=window)
 
-        def step(acc, bit):
-            acc = self.add(acc, acc)
-            mask = jnp.broadcast_to(bit.astype(bool), acc.x.shape[:batch_rank])
-            acc = self.select(mask, self.add(acc, p), acc)
-            return acc, None
+    def _window_table(self, p: Point, window: int):
+        """[0·p, 1·p, ..., (2^w −1)·p] stacked on a new leading axis."""
+        tables = [self.infinity_like(p.x), p]
+        for _ in range(2, 1 << window):
+            tables.append(self.add(tables[-1], p))
+        return Point(jnp.stack([t.x for t in tables]),
+                     jnp.stack([t.y for t in tables]),
+                     jnp.stack([t.z for t in tables]))
 
-        acc, _ = lax.scan(step, acc, jnp.asarray(list(bits), jnp.int32))
-        return acc
+    def _table_lookup(self, table: Point, digit: Array) -> Point:
+        """Per-lane table row selection by digit — a one-hot contraction
+        (16-way weighted add beats a gather on the VPU and keeps the
+        graph scan-friendly)."""
+        k = table.x.shape[0]
+        onehot = (digit[None] == jnp.arange(k)[(...,) + (None,) * digit.ndim]
+                  ).astype(jnp.int32)
+        oh = onehot.reshape(onehot.shape + (1,) * self._coord_rank())
+        return Point((table.x * oh).sum(0), (table.y * oh).sum(0),
+                     (table.z * oh).sum(0))
 
     def _coord_rank(self) -> int:
         """Number of trailing field axes in a coordinate array (1 for Fq,
         2 for Fq2)."""
         return self.f.one().ndim
 
-    def scalar_mul_bits(self, p: Point, bits: Array) -> Point:
+    def scalar_mul_bits(self, p: Point, bits: Array, window: int = 4
+                        ) -> Point:
         """p_i · k_i with per-element scalars given as an MSB-first bit
-        array of shape batch_shape + (nbits,).  Uniform double-and-add scan
-        (complete addition makes every iteration identical)."""
-        acc = self.infinity_like(p.x)
-        bits_scan = jnp.moveaxis(bits, -1, 0)  # (nbits, ...batch)
+        array of shape batch_shape + (nbits,).  Fixed-window double-and-
+        add: a per-lane [0..2^w)·p table (2^w − 2 adds, batch-amortized),
+        then nbits/w scan steps of w doublings + one table add — ~1.35x
+        fewer point ops than bit-serial at w=4 (complete addition keeps
+        every step uniform either way)."""
+        nbits = bits.shape[-1]
+        if window <= 1 or nbits % window != 0:
+            acc = self.infinity_like(p.x)
+            bits_scan = jnp.moveaxis(bits, -1, 0)  # (nbits, ...batch)
 
-        def step(acc, bit):
-            acc = self.add(acc, acc)
-            acc = self.select(bit.astype(bool), self.add(acc, p), acc)
-            return acc, None
+            def step(acc, bit):
+                acc = self.add(acc, acc)
+                acc = self.select(bit.astype(bool), self.add(acc, p), acc)
+                return acc, None
 
-        acc, _ = lax.scan(step, acc, bits_scan)
+            acc, _ = lax.scan(step, acc, bits_scan)
+            return acc
+
+        table = self._window_table(p, window)
+        weights = jnp.asarray([1 << (window - 1 - i) for i in range(window)],
+                              jnp.int32)
+        digits = jnp.moveaxis(
+            (bits.reshape(bits.shape[:-1] + (nbits // window, window))
+             * weights).sum(-1), -1, 0)  # (nbits/w, ...batch)
+
+        def wstep(acc, digit):
+            for _ in range(window):
+                acc = self.add(acc, acc)
+            return self.add(acc, self._table_lookup(table, digit)), None
+
+        acc, _ = lax.scan(wstep, self.infinity_like(p.x), digits)
         return acc
 
     # -- reductions ----------------------------------------------------------

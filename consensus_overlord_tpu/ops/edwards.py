@@ -1,0 +1,283 @@
+"""Batched edwards25519 (Ed25519) curve ops on the generic TPU field layer.
+
+The BLS12-381 stack (ops/field.py + ops/curve.py) is curve-generic by
+design; this module instantiates it for the Ed25519 simulation configs
+(BASELINE.md configs 2 and 5): twisted Edwards points in extended
+coordinates with the a=-1 unified addition law — complete on edwards25519
+(a = -1 is a square mod 2^255-19, d is not), so scalar-mul scans and tree
+reductions are single-formula, exactly like the Weierstrass complete-
+addition path the BLS kernels use.
+
+Reference anchor: the reference has no Ed25519 (BLS only, src/
+consensus.rs:336-337); this backs the rebuild's large-fleet sim configs
+where pairing cost would mask engine behavior (BASELINE.md config 2).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .field import ED25519_P, FieldSpec
+
+Array = jax.Array
+
+FE = FieldSpec(ED25519_P, name="F_ed25519")
+
+P = ED25519_P
+#: group order (prime subgroup): 2^252 + δ
+L = 2**252 + 27742317777372353535851937790883648493
+#: curve constant d = -121665/121666
+D = (-121665 * pow(121666, P - 2, P)) % P
+D2 = (2 * D) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+#: base point: y = 4/5, x = the even root (recomputed, not transcribed)
+_B_Y = (4 * pow(5, P - 2, P)) % P
+
+
+def _xrecover(y: int, sign: int):
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    x = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    if (v * x * x - u) % P != 0:
+        x = x * SQRT_M1 % P
+    if (v * x * x - u) % P != 0:
+        return None
+    if x == 0 and sign:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+_B_X = _xrecover(_B_Y, 0)
+assert _B_X is not None and _B_X & 1 == 0
+
+_D2_ROW = jnp.asarray(FE.from_int(D2))
+_SQRT_M1_ROW = jnp.asarray(FE.from_int(SQRT_M1))
+_D_ROW = jnp.asarray(FE.from_int(D))
+
+
+class EdPoint(NamedTuple):
+    """Extended coordinates (X : Y : Z : T), T = XY/Z."""
+    x: Array
+    y: Array
+    z: Array
+    t: Array
+
+
+def identity_like(coord: Array) -> EdPoint:
+    zero = jnp.zeros_like(coord)
+    one = jnp.broadcast_to(FE.one(), coord.shape).astype(jnp.int32)
+    return EdPoint(zero, one, one, zero)
+
+
+def from_affine(x: Array, y: Array) -> EdPoint:
+    one = jnp.broadcast_to(FE.one(), x.shape).astype(jnp.int32)
+    return EdPoint(x, y, one, FE.mul(x, y))
+
+
+def base_point(batch: int = 1) -> EdPoint:
+    x = jnp.broadcast_to(jnp.asarray(FE.from_int(_B_X)), (batch, FE.n))
+    y = jnp.broadcast_to(jnp.asarray(FE.from_int(_B_Y)), (batch, FE.n))
+    return from_affine(x.astype(jnp.int32), y.astype(jnp.int32))
+
+
+def add(p: EdPoint, q: EdPoint) -> EdPoint:
+    """HWCD unified addition (a = -1), complete on edwards25519 — the
+    same one-formula-for-everything shape as Weierstrass complete
+    addition, so it scans."""
+    a = FE.mul(FE.sub(p.y, p.x), FE.sub(q.y, q.x))
+    b = FE.mul(FE.add(p.y, p.x), FE.add(q.y, q.x))
+    c = FE.mul(FE.mul(p.t, _D2_ROW), q.t)
+    d = FE.mul(FE.add(p.z, p.z), q.z)
+    e = FE.sub(b, a)
+    f = FE.sub(d, c)
+    g = FE.add(d, c)
+    h = FE.add(b, a)
+    return EdPoint(FE.mul(e, f), FE.mul(g, h), FE.mul(f, g), FE.mul(e, h))
+
+
+def neg(p: EdPoint) -> EdPoint:
+    return EdPoint(FE.neg(p.x), p.y, p.z, FE.neg(p.t))
+
+
+def select(mask: Array, p: EdPoint, q: EdPoint) -> EdPoint:
+    m = mask[..., None]
+    return EdPoint(jnp.where(m, p.x, q.x), jnp.where(m, p.y, q.y),
+                   jnp.where(m, p.z, q.z), jnp.where(m, p.t, q.t))
+
+
+def is_identity(p: EdPoint) -> Array:
+    """(0 : λ : λ : 0) — X = 0, T = 0, Y = Z (Y = -Z is the 2-torsion
+    point (0, -1), which [8]·(anything) never leaves behind)."""
+    return FE.is_zero(p.x) & FE.is_zero(p.t) & FE.eq(p.y, p.z)
+
+
+def scalar_mul_bits(p: EdPoint, bits: Array) -> EdPoint:
+    """p_i · k_i, per-lane MSB-first bit arrays: batch + (nbits,)."""
+    acc = identity_like(p.x)
+    bits_scan = jnp.moveaxis(bits, -1, 0)
+
+    def step(acc, bit):
+        acc2 = add(acc, acc)
+        return select(bit.astype(bool), add(acc2, p), acc2), None
+
+    acc, _ = lax.scan(step, acc, bits_scan)
+    return acc
+
+
+def tree_sum(p: EdPoint) -> EdPoint:
+    """Σ over the leading batch axis in log2 steps (pad to pow2 with
+    identity)."""
+    n = p.x.shape[0]
+    size = 1 << max(1, (n - 1).bit_length())
+    if size != n:
+        pad = identity_like(jnp.zeros((size - n,) + p.x.shape[1:],
+                                      jnp.int32))
+        p = EdPoint(*(jnp.concatenate([a, b], axis=0)
+                      for a, b in zip(p, pad)))
+    while p.x.shape[0] > 1:
+        half = p.x.shape[0] // 2
+        p = add(EdPoint(*(a[:half] for a in p)),
+                EdPoint(*(a[half:] for a in p)))
+    return p
+
+
+def mul8(p: EdPoint) -> EdPoint:
+    p = add(p, p)
+    p = add(p, p)
+    return add(p, p)
+
+
+def decompress(y: Array, sign: Array) -> Tuple[EdPoint, Array]:
+    """Batched point decompression: recover x from y and the sign bit.
+    Returns (point, valid); invalid lanes carry garbage flagged False."""
+    one = jnp.broadcast_to(FE.one(), y.shape).astype(jnp.int32)
+    y2 = FE.sq(y)
+    u = FE.sub(y2, one)
+    v = FE.add(FE.mul(_D_ROW, y2), one)
+    v3 = FE.mul(FE.sq(v), v)
+    v7 = FE.mul(FE.sq(v3), v)
+    pow_arg = FE.mul(u, v7)
+    w = FE.pow_static(pow_arg, (P - 5) // 8)
+    x = FE.mul(FE.mul(u, v3), w)
+    vx2 = FE.mul(v, FE.sq(x))
+    root_ok = FE.eq(vx2, u)
+    neg_ok = FE.eq(vx2, FE.neg(u))
+    x = FE.where(~root_ok & neg_ok, FE.mul(x, _SQRT_M1_ROW), x)
+    valid = root_ok | neg_ok
+    x_is_zero = FE.is_zero(x)
+    valid = valid & ~(x_is_zero & sign)
+    parity = (FE.strict(x)[..., 0] & 1) == 1
+    x = FE.where(parity != sign, FE.neg(x), x)
+    return from_affine(x, y), valid
+
+
+# ---------------------------------------------------------------------------
+# Host-side parsing
+# ---------------------------------------------------------------------------
+
+class ParsedEd(NamedTuple):
+    y: np.ndarray       # (B, n) int32 limb rows
+    sign: np.ndarray    # (B,) bool
+    wellformed: np.ndarray  # (B,) bool
+
+
+def parse_points(blobs: Sequence[bytes]) -> ParsedEd:
+    """32-byte little-endian encodings -> limb rows + sign bits.
+    y >= p is rejected host-side (non-canonical encoding)."""
+    b = len(blobs)
+    y = np.zeros((b, FE.n), np.int32)
+    sign = np.zeros(b, bool)
+    ok = np.zeros(b, bool)
+    for i, blob in enumerate(blobs):
+        if len(blob) != 32:
+            continue
+        v = int.from_bytes(blob, "little")
+        s = bool(v >> 255)
+        yv = v & ((1 << 255) - 1)
+        if yv >= P:
+            continue
+        y[i] = FE.from_int(yv)
+        sign[i] = s
+        ok[i] = True
+    return ParsedEd(y, sign, ok)
+
+
+def int_to_bits_msb(values: Sequence[int], nbits: int) -> np.ndarray:
+    """MSB-first bit matrix — shared helper, see ops/curve.py."""
+    from .curve import int_to_bits_msb as _impl
+    return np.asarray(_impl(values, nbits))
+
+
+# ---------------------------------------------------------------------------
+# Host-side cofactored verification (Python ints) — the per-lane fallback
+# of the device batch path.  MUST use the same acceptance rule as the
+# batched relation ([8]-multiplied, RFC 8032-permitted), or two honest
+# nodes could disagree about one adversarial torsioned signature
+# depending on which path verified it (a consensus-divergence hazard;
+# cf. ZIP-215's motivation).
+# ---------------------------------------------------------------------------
+
+def _host_add(p, q):
+    (x1, y1), (x2, y2) = p, q
+    x1y2, x2y1 = x1 * y2 % P, x2 * y1 % P
+    y1y2, x1x2 = y1 * y2 % P, x1 * x2 % P
+    dxy = D * x1x2 % P * y1y2 % P
+    x3 = (x1y2 + x2y1) * pow(1 + dxy, P - 2, P) % P
+    y3 = (y1y2 + x1x2) * pow(1 - dxy + P, P - 2, P) % P
+    return (x3, y3)
+
+
+def _host_mul(p, k: int):
+    acc = (0, 1)
+    for bit in bin(k)[2:] if k else "0":
+        acc = _host_add(acc, acc)
+        if bit == "1":
+            acc = _host_add(acc, p)
+    return acc
+
+
+def _host_decompress(blob: bytes):
+    if len(blob) != 32:
+        return None
+    v = int.from_bytes(blob, "little")
+    sign = v >> 255
+    y = v & ((1 << 255) - 1)
+    if y >= P:
+        return None
+    x = _xrecover(y, sign)
+    if x is None:
+        return None
+    return (x, y)
+
+
+def host_verify_cofactored(signature: bytes, message: bytes,
+                           pubkey: bytes) -> bool:
+    """[8]([s]B − R − [h]A) == identity over Python ints — bit-for-bit the
+    batch relation at batch size one."""
+    import hashlib
+
+    if len(signature) != 64:
+        return False
+    r_pt = _host_decompress(signature[:32])
+    a_pt = _host_decompress(bytes(pubkey))
+    if r_pt is None or a_pt is None:
+        return False
+    s = int.from_bytes(signature[32:], "little")
+    if s >= L:
+        return False
+    h = int.from_bytes(
+        hashlib.sha512(signature[:32] + bytes(pubkey) + bytes(message))
+        .digest(), "little") % L
+    sb = _host_mul((_B_X, _B_Y), s)
+    rhs = _host_add(r_pt, _host_mul(a_pt, h))
+    diff = _host_add(sb, (P - rhs[0], rhs[1]))  # sb − rhs
+    eight = _host_mul(diff, 8)
+    return eight == (0, 1)

@@ -1,0 +1,129 @@
+"""Device Ed25519 batch verification vs the host `cryptography` backend
+(RFC 8032 signatures): curve ops, decompression, and the end-to-end
+batch relation with exact per-lane localization."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_overlord_tpu.core.sm3 import sm3_hash
+from consensus_overlord_tpu.crypto.ed25519_tpu import Ed25519TpuCrypto
+from consensus_overlord_tpu.crypto.provider import Ed25519Crypto
+from consensus_overlord_tpu.ops import edwards as ed
+
+
+class TestEdwardsOps(unittest.TestCase):
+    def test_decompress_base_point(self):
+        enc = (ed._B_Y | ((ed._B_X & 1) << 255)).to_bytes(32, "little")
+        parsed = ed.parse_points([enc])
+        pt, valid = ed.decompress(jnp.asarray(parsed.y),
+                                  jnp.asarray(parsed.sign))
+        self.assertTrue(bool(valid[0]))
+        (x,) = ed.FE.to_ints(pt.x)
+        (y,) = ed.FE.to_ints(pt.y)
+        self.assertEqual(x, ed._B_X)
+        self.assertEqual(y, ed._B_Y)
+
+    def test_bad_point_rejected(self):
+        # y = 2 is not on the curve (x^2 would be non-square)
+        bad = (2).to_bytes(32, "little")
+        parsed = ed.parse_points([bad])
+        _, valid = ed.decompress(jnp.asarray(parsed.y),
+                                 jnp.asarray(parsed.sign))
+        self.assertFalse(bool(valid[0]))
+        # non-canonical y >= p rejected at parse
+        noncanon = (ed.P + 1).to_bytes(32, "little")
+        parsed = ed.parse_points([noncanon])
+        self.assertFalse(bool(parsed.wellformed[0]))
+
+    def test_scalar_mul_matches_host(self):
+        """[k]B on device == host reference (affine double-and-add in
+        Python ints)."""
+        def host_add(p, q):
+            (x1, y1), (x2, y2) = p, q
+            x1y2, x2y1 = x1 * y2 % ed.P, x2 * y1 % ed.P
+            y1y2, x1x2 = y1 * y2 % ed.P, x1 * x2 % ed.P
+            dxy = ed.D * x1x2 % ed.P * y1y2 % ed.P
+            x3 = (x1y2 + x2y1) * pow(1 + dxy, ed.P - 2, ed.P) % ed.P
+            y3 = (y1y2 + x1x2) * pow(1 - dxy + ed.P, ed.P - 2, ed.P) % ed.P
+            return (x3, y3)
+
+        for k in (1, 2, 3, 7, 0xDEAD):
+            want = (0, 1)
+            for bit in bin(k)[2:]:
+                want = host_add(want, want)
+                if bit == "1":
+                    want = host_add(want, (ed._B_X, ed._B_Y))
+            bits = jnp.asarray(ed.int_to_bits_msb([k], 16))
+            pt = ed.scalar_mul_bits(ed.base_point(1), bits)
+            zi = pow(int(ed.FE.to_ints(pt.z)[0]), ed.P - 2, ed.P)
+            x = int(ed.FE.to_ints(pt.x)[0]) * zi % ed.P
+            y = int(ed.FE.to_ints(pt.y)[0]) * zi % ed.P
+            self.assertEqual((x, y), want, k)
+
+
+class TestEd25519Batch(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        cls.cryptos = [Ed25519Crypto(bytes([i]) * 32) for i in range(1, 9)]
+        cls.msgs = [sm3_hash(b"ed-batch-%d" % i) for i in range(8)]
+        cls.sigs = [c.sign(m) for c, m in zip(cls.cryptos, cls.msgs)]
+        cls.pks = [c.pub_key for c in cls.cryptos]
+        cls.prov = Ed25519TpuCrypto(b"\x99" * 32, device_threshold=1)
+
+    def test_all_valid(self):
+        got = self.prov.verify_batch(self.sigs, self.msgs, self.pks)
+        self.assertEqual(got, [True] * 8)
+
+    def test_bad_lane_localized(self):
+        sigs = list(self.sigs)
+        bad = bytearray(sigs[5])
+        bad[2] ^= 0xFF
+        sigs[5] = bytes(bad)
+        got = self.prov.verify_batch(sigs, self.msgs, self.pks)
+        self.assertEqual(got, [True] * 5 + [False] + [True] * 2)
+
+    def test_wrong_signer_localized(self):
+        sigs = list(self.sigs)
+        sigs[0] = self.cryptos[1].sign(self.msgs[0])
+        got = self.prov.verify_batch(sigs, self.msgs, self.pks)
+        self.assertEqual(got, [False] + [True] * 7)
+
+    def test_malformed_inputs_false_not_crash(self):
+        sigs = list(self.sigs)
+        pks = list(self.pks)
+        sigs[1] = b"\x01" * 17            # bad length
+        pks[2] = b"\x02" * 31             # bad length
+        # non-canonical s >= L
+        s_big = (ed.L + 5).to_bytes(32, "little")
+        sigs[3] = self.sigs[3][:32] + s_big
+        got = self.prov.verify_batch(sigs, self.msgs, pks)
+        self.assertEqual(got, [True, False, False, False,
+                               True, True, True, True])
+
+    def test_agrees_with_host_verifier(self):
+        got = self.prov.verify_batch(self.sigs, self.msgs, self.pks)
+        want = [c.verify_signature(s, m, pk) for c, s, m, pk in
+                zip(self.cryptos, self.sigs, self.msgs, self.pks)]
+        self.assertEqual(got, want)
+
+    def test_single_path_is_cofactored_host_rule(self):
+        """The provider's single verify (the sub-threshold / fallback
+        path) must apply the same rule as the batch relation; it accepts
+        honest signatures and rejects corrupt ones like OpenSSL does."""
+        self.assertTrue(self.prov.verify_signature(
+            self.sigs[0], self.msgs[0], self.pks[0]))
+        bad = bytearray(self.sigs[0])
+        bad[1] ^= 0x01
+        self.assertFalse(self.prov.verify_signature(
+            bytes(bad), self.msgs[0], self.pks[0]))
+        # sub-threshold batches route through it too
+        small = Ed25519TpuCrypto(b"\x88" * 32, device_threshold=64)
+        self.assertEqual(
+            small.verify_batch(self.sigs[:3], self.msgs[:3], self.pks[:3]),
+            [True] * 3)
+
+
+if __name__ == "__main__":
+    unittest.main()
