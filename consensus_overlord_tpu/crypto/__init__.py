@@ -1,5 +1,9 @@
-"""Crypto port and backends: CPU oracle (BLS12-381, Ed25519) and TPU-batched
-providers (limb-field arithmetic under jit, Pallas kernels)."""
+"""Crypto port and backends: CPU oracles (BLS12-381, Ed25519, secp256k1,
+SM2) and TPU-batched providers (limb-field arithmetic under jit).
+
+Device-batched providers live in their own modules so importing this
+package stays cheap: tpu_provider (BLS), ed25519_tpu, ecdsa_tpu
+(secp256k1 + SM2)."""
 
 from .provider import (  # noqa: F401
     CpuBlsCrypto,

@@ -1,0 +1,151 @@
+"""Batched short-Weierstrass curves beyond BLS12-381: secp256k1 and SM2.
+
+The BLS stack's field/curve layers (ops/field.py, ops/curve.py) are
+curve-generic; this module instantiates them for the ECDSA-family curves
+of the large-fleet simulation configs (BASELINE.md configs 3 and 5):
+
+* **secp256k1** — y² = x³ + 7 (a = 0): reuses `CurveOps`' a = 0 complete
+  addition unchanged, over a new 26-limb `FieldSpec`.
+* **SM2** — y² = x³ − 3x + b (a = −3): needs the *general-a* complete
+  addition (Renes–Costello–Batina 2016, Algorithm 1; 12M + 3·mul_a +
+  2·mul_b3).  `GeneralCurveOps` overrides the two a-dependent methods.
+
+Both get `dual_scalar_mul_bits` — the Shamir-interleaved u1·G + u2·Q the
+ECDSA/SM2 verification equation needs: shared doubling run, two windowed
+table lookups per step (the fixed base G's table is broadcast across
+lanes, each lane keeps its own table for Q).
+
+Reference anchor: the reference is BLS-only (src/consensus.rs:336-337);
+these curves back the rebuild's mixed-curve fleet configs where the
+driver's BASELINE.json calls for secp256k1 (config 3) and SM2 (config 5).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from .curve import CurveOps, Point
+from .field import SECP256K1_P, SM2_P, Array, FieldSpec
+
+# -- fields ------------------------------------------------------------------
+
+FQ_SECP = FieldSpec(SECP256K1_P, name="secp256k1_fq")
+FQ_SM2 = FieldSpec(SM2_P, name="sm2_fq")
+
+#: secp256k1 group order (prime, cofactor 1)
+SECP256K1_N = int("fffffffffffffffffffffffffffffffe"
+                  "baaedce6af48a03bbfd25e8cd0364141", 16)
+#: secp256k1 base point (SEC 2 v2 §2.4.1)
+SECP256K1_GX = int("79be667ef9dcbbac55a06295ce870b07"
+                   "029bfcdb2dce28d959f2815b16f81798", 16)
+SECP256K1_GY = int("483ada7726a3c4655da4fbfc0e1108a8"
+                   "fd17b448a68554199c47d08ffb10d4b8", 16)
+SECP256K1_B = 7
+
+#: SM2 recommended curve (GB/T 32918.5): a = p − 3
+SM2_A = SM2_P - 3
+SM2_B = int("28e9fa9e9d9f5e344d5a9e4bcf6509a7"
+            "f39789f515ab8f92ddbcbd414d940e93", 16)
+SM2_N = int("fffffffeffffffffffffffffffffffff"
+            "7203df6b21c6052b53bbf40939d54123", 16)
+SM2_GX = int("32c4ae2c1f1981195f9904466a39c994"
+             "8fe30bbff2660be1715a4589334c74c7", 16)
+SM2_GY = int("bc3736a2f4f6779c59bdcee36b692153"
+             "d0a9877cc62a474002df32e52139f0a0", 16)
+
+
+class GeneralCurveOps(CurveOps):
+    """Complete projective addition for arbitrary a (RCB 2016 Alg. 1).
+
+    `mul_a`: multiply a field element by the curve's a (a callable so
+    small/negative a uses the cheap mul_small/neg path instead of a full
+    field multiplication)."""
+
+    def __init__(self, field, mul_a: Callable[[Array], Array],
+                 mul_b3: Callable[[Array], Array], name: str):
+        super().__init__(field, mul_b3, name)
+        self.mul_a = mul_a
+
+    def add(self, p: Point, q: Point) -> Point:
+        f, mul_a, mul_b3 = self.f, self.mul_a, self.mul_b3
+        x1, y1, z1 = p
+        x2, y2, z2 = q
+        t0 = f.mul(x1, x2)
+        t1 = f.mul(y1, y2)
+        t2 = f.mul(z1, z2)
+        t3 = f.sub(f.mul(f.add(x1, y1), f.add(x2, y2)),
+                   f.add(t0, t1))                      # x1y2 + x2y1
+        t4 = f.sub(f.mul(f.add(x1, z1), f.add(x2, z2)),
+                   f.add(t0, t2))                      # x1z2 + x2z1
+        t5 = f.sub(f.mul(f.add(y1, z1), f.add(y2, z2)),
+                   f.add(t1, t2))                      # y1z2 + y2z1
+        z3 = f.add(mul_b3(t2), mul_a(t4))
+        x3 = f.sub(t1, z3)
+        z3 = f.add(t1, z3)
+        y3 = f.mul(x3, z3)
+        t1 = f.add(f.mul_small(t0, 3), mul_a(t2))      # 3x1x2 + a·z1z2
+        t2 = mul_a(f.sub(t0, mul_a(t2)))               # a·(x1x2 − a·z1z2)
+        t4 = f.add(mul_b3(t4), t2)
+        y3 = f.add(y3, f.mul(t1, t4))
+        x3 = f.sub(f.mul(t3, x3), f.mul(t5, t4))
+        z3 = f.add(f.mul(t5, z3), f.mul(t3, t1))
+        return Point(x3, y3, z3)
+
+    def on_curve(self, p: Point) -> Array:
+        """3·Y²Z == 3·X³ + 3a·XZ² + 3b·Z³ (identity passes)."""
+        f = self.f
+        z2 = f.sq(p.z)
+        lhs = f.mul_small(f.mul(f.sq(p.y), p.z), 3)
+        rhs = f.add(f.mul_small(f.mul(f.sq(p.x), p.x), 3),
+                    f.add(f.mul_small(self.mul_a(f.mul(p.x, z2)), 3),
+                          self.mul_b3(f.mul(z2, p.z))))
+        return f.eq(lhs, rhs)
+
+
+SECP = CurveOps(FQ_SECP,
+                mul_b3=lambda x: FQ_SECP.mul_small(x, 3 * SECP256K1_B),
+                name="secp256k1")
+
+_SM2_B3_ROW = jnp.asarray(FQ_SM2.from_int(3 * SM2_B % SM2_P))
+SM2 = GeneralCurveOps(
+    FQ_SM2,
+    mul_a=lambda x: FQ_SM2.neg(FQ_SM2.mul_small(x, 3)),
+    mul_b3=lambda x: FQ_SM2.mul(x, _SM2_B3_ROW),
+    name="sm2")
+
+
+def dual_scalar_mul_bits(ops: CurveOps, g: Point, g_bits: Array,
+                         q: Point, q_bits: Array, window: int = 4) -> Point:
+    """Per-lane u1·G + u2·Q with one shared doubling run (Shamir's trick):
+    each window step does `window` doublings plus two table adds, so the
+    two-term MSM costs ~6/5 of a single windowed scalar-mul instead of 2x.
+
+    `g` is a broadcastable batch of base points (typically one fixed G of
+    batch shape (1, n) broadcast against q's (B, n) lanes); both bit
+    arrays are MSB-first with a length divisible by `window`."""
+    nbits = g_bits.shape[-1]
+    assert nbits == q_bits.shape[-1] and nbits % window == 0
+    tg = ops._window_table(g, window)
+    tq = ops._window_table(q, window)
+    weights = jnp.asarray([1 << (window - 1 - i) for i in range(window)],
+                          jnp.int32)
+
+    def digits(bits):
+        return jnp.moveaxis(
+            (bits.reshape(bits.shape[:-1] + (nbits // window, window))
+             * weights).sum(-1), -1, 0)  # (nbits/w, ...batch)
+
+    def step(acc, dd):
+        dg, dq = dd
+        for _ in range(window):
+            acc = ops.add(acc, acc)
+        acc = ops.add(acc, ops._table_lookup(tg, dg))
+        acc = ops.add(acc, ops._table_lookup(tq, dq))
+        return acc, None
+
+    acc0 = ops.infinity_like(q.x)
+    acc, _ = lax.scan(step, acc0, (digits(g_bits), digits(q_bits)))
+    return acc

@@ -11,6 +11,7 @@ This is the DP analog named in SURVEY.md §2.3; sharding one MSM's point
 range across devices plays the role tensor parallelism plays in ML stacks.
 """
 
+from .multihost import global_mesh, init_multihost  # noqa: F401
 from .sharded import (  # noqa: F401
     make_mesh,
     sharded_g1_validate_sum,

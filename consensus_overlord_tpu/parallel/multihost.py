@@ -1,0 +1,97 @@
+"""Multi-host (DCN) initialization for the crypto mesh.
+
+The reference scales across machines by running one validator process per
+node and exchanging BFT messages through its network microservice
+(SURVEY.md §2.3 — gRPC, no collectives).  This framework keeps that
+host-level shape AND adds a second, device-level axis the reference
+cannot have: one validator's crypto batch sharded over every chip of a
+multi-host TPU slice.
+
+Topology recipe (the scaling-book shape):
+
+* Within a host/slice, lanes shard over the chips and the partial group
+  sums combine over **ICI** (parallel/sharded.py — plain `all_gather`
+  over the mesh axis; XLA routes it on the interconnect).
+* Across hosts, `jax.distributed.initialize` brings every process's
+  local devices into one global runtime reachable over **DCN**; a mesh
+  built from `jax.devices()` then spans all of them.  Keeping the mesh
+  axis ordered host-major (the `jax.devices()` order) makes the
+  all-gather hierarchical: ICI hops first, one DCN exchange per host.
+
+A consensus deployment that wants TPU-per-validator needs none of this —
+each validator has its own chip(s) and the single-host mesh.  DCN enters
+when one *verification service* (the flagship scale story: a 10k-
+validator fleet's QC audit) owns a whole pod slice.
+
+The environment this framework builds in exposes one chip and no
+multi-host slice, so `init_multihost` is exercised in its single-process
+degenerate form by tests; the multi-process path follows the documented
+JAX contract (jax.distributed.initialize is idempotent per process and
+fails loudly on misconfiguration, which we surface rather than wrap).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from jax.sharding import Mesh
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> bool:
+    """Join (or skip joining) a multi-host JAX runtime.
+
+    With no arguments, reads the standard env vars the launcher sets
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, the
+    same triple jax.distributed.initialize reads on non-TPU platforms;
+    on Cloud TPU the TPU metadata service supplies them and plain
+    `jax.distributed.initialize()` is the whole dance).
+
+    Returns True if a multi-process runtime was initialized, False if
+    this is a single-process run (no coordinator configured) — callers
+    use the same `make_mesh()` either way, it just sees more devices.
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if coordinator_address is None:
+        return False
+    import jax
+
+    kwargs = {"coordinator_address": coordinator_address}
+    num_processes = (num_processes if num_processes is not None else
+                     _env_int("JAX_NUM_PROCESSES"))
+    process_id = (process_id if process_id is not None else
+                  _env_int("JAX_PROCESS_ID"))
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return True
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def global_mesh(axis: str = "lanes") -> Mesh:
+    """A 1-D mesh over every device of the (possibly multi-host) runtime,
+    host-major so the combine all-gather is ICI-first with one DCN stage
+    (see module docstring).  The sharded kernels in parallel/sharded.py
+    take this mesh unchanged — lanes shard globally; each host feeds its
+    local shard via jax.make_array_from_process_local_data when the batch
+    originates per-host."""
+    import jax
+
+    import numpy as np
+
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()

@@ -21,8 +21,13 @@ def main() -> None:
     parser.add_argument("--heights", type=int, default=5)
     parser.add_argument("--interval-ms", type=int, default=100)
     parser.add_argument("--drop-rate", type=float, default=0.0)
-    parser.add_argument("--crypto", choices=["ed25519", "bls"],
+    parser.add_argument("--crypto",
+                        choices=["ed25519", "bls", "secp256k1", "sm2"],
                         default="ed25519")
+    parser.add_argument("--tpu", action="store_true",
+                        help="use the device-batched provider for the "
+                        "chosen scheme (batches ship to the TPU once the "
+                        "frontier coalesces past the provider threshold)")
     parser.add_argument("--timeout", type=float, default=120.0)
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args()
@@ -34,9 +39,31 @@ def main() -> None:
     from . import SimNetwork
 
     if args.crypto == "bls":
-        from ..crypto.provider import CpuBlsCrypto
+        if args.tpu:
+            from ..crypto.tpu_provider import TpuBlsCrypto
 
-        factory = lambda i: CpuBlsCrypto(0x1000 + 7919 * i)  # noqa: E731
+            factory = lambda i: TpuBlsCrypto(0x1000 + 7919 * i)  # noqa: E731
+        else:
+            from ..crypto.provider import CpuBlsCrypto
+
+            factory = lambda i: CpuBlsCrypto(0x1000 + 7919 * i)  # noqa: E731
+    elif args.crypto in ("secp256k1", "sm2"):
+        from ..crypto.ecdsa_tpu import Secp256k1Crypto, Sm2Crypto
+
+        cls = Secp256k1Crypto if args.crypto == "secp256k1" else Sm2Crypto
+        base = 0x2000 if args.crypto == "secp256k1" else 0x3000
+        # --tpu: ship QC/frontier batches to the device from size 8 up;
+        # otherwise keep every verify on the host so the reported "tpu"
+        # field is truthful (the provider would silently engage the
+        # device past its default threshold).
+        thresh = 8 if args.tpu else 10**9
+        factory = lambda i: cls(base + 7919 * i,  # noqa: E731
+                                device_threshold=thresh)
+    elif args.tpu:
+        from ..crypto.ed25519_tpu import Ed25519TpuCrypto
+
+        factory = lambda i: Ed25519TpuCrypto(  # noqa: E731
+            (0x4000 + 7919 * i).to_bytes(32, "big"))
     else:
         factory = None
 
@@ -47,20 +74,30 @@ def main() -> None:
         net.start(init_height=1)
         t0 = time.perf_counter()
         last = t0
+        height_ms = []
         for h in range(1, args.heights + 1):
             await net.run_until_height(h, timeout=args.timeout)
             now = time.perf_counter()
-            print(f"height {h} committed (+{(now - last) * 1000:.1f} ms)")
+            height_ms.append((now - last) * 1000)
+            print(f"height {h} committed (+{height_ms[-1]:.1f} ms)")
             last = now
         total = time.perf_counter() - t0
         await net.stop()
+        srt = sorted(height_ms)
+
+        def pct(q: float) -> float:
+            return round(srt[min(len(srt) - 1, int(q * len(srt)))], 1)
+
         return {
             "metric": "consensus-rounds",
             "validators": args.validators,
             "heights": args.heights,
             "crypto": args.crypto,
+            "tpu": args.tpu,
             "total_s": round(total, 3),
             "ms_per_height": round(total * 1000 / args.heights, 1),
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
             "delivered": net.router.delivered,
             "dropped": net.router.dropped,
         }

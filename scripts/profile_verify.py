@@ -1,0 +1,124 @@
+"""Break down verify_batch wall time into stages on the real device.
+
+Usage:  python scripts/profile_verify.py [N]
+
+Stages timed separately (each with block_until_ready):
+  parse      — host parse of N compressed G1 sigs
+  g1_msm     — device decompress+validate+RLC-MSM over signatures
+  g2_msm     — device RLC-MSM over cached pubkey rows
+  pairing    — host 2-pairing batch check (native backend if built)
+  full       — end-to-end provider.verify_batch
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+
+
+def timeit(label, fn, iters=4):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label:12s} {dt * 1e3:9.2f} ms")
+    return out, dt
+
+
+def main():
+    from consensus_overlord_tpu.compile_cache import enable
+    enable()
+    import jax.numpy as jnp
+
+    from consensus_overlord_tpu.core.sm3 import sm3_hash
+    from consensus_overlord_tpu.crypto import bls12381 as oracle
+    from consensus_overlord_tpu.crypto import tpu_provider as tp
+    from consensus_overlord_tpu.ops import bls12381_groups as dev
+
+    print(f"device: {jax.devices()[0].platform}  N={N}")
+    h = sm3_hash(b"profile")
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                         ".bench_fixture.npz")
+    if os.path.exists(cache):
+        data = np.load(cache)
+        if data["sigs"].shape[0] >= N:
+            sigs = [bytes(r) for r in data["sigs"][:N]]
+            pks = [bytes(r) for r in data["pks"][:N]]
+        else:
+            sigs = pks = None
+    else:
+        sigs = pks = None
+    if sigs is None:
+        h2 = sm3_hash(b"bench-block-hash")
+        sks = [0xBEEF + 97 * i for i in range(N)]
+        sigs = [oracle.sign(sk, h2) for sk in sks]
+        pks = [oracle.sk_to_pk(sk) for sk in sks]
+        h = h2
+    else:
+        h = sm3_hash(b"bench-block-hash")
+
+    provider = tp.TpuBlsCrypto(0xA11CE)
+    provider.update_pubkeys(pks)
+
+    parsed, _ = timeit("parse", lambda: dev.parse_g1_compressed(sigs))
+    size = provider._pad_to(N)
+
+    x = np.zeros((size, dev.FQ.n), np.int32)
+    x[:N] = parsed.x
+    sgn = np.zeros(size, bool)
+    sgn[:N] = parsed.sign
+    inf = np.zeros(size, bool)
+    ok = np.zeros(size, bool)
+    ok[:N] = parsed.wellformed
+    bits = np.zeros((size, tp._SCALAR_BITS), np.int32)
+    bits[:N] = np.unpackbits(
+        np.frombuffer(os.urandom(N * tp._SCALAR_BITS // 8), np.uint8)
+        .reshape(N, -1), axis=1)
+
+    def g1():
+        out = provider._kernels.g1_validate_msm(
+            jnp.asarray(x), jnp.asarray(sgn), jnp.asarray(inf),
+            jnp.asarray(ok), jnp.asarray(bits))
+        jax.block_until_ready(out)
+        return out
+
+    (ax, ay, ainf, valid), g1_dt = timeit("g1_msm", g1)
+
+    rows = provider._pk_rows_of(pks)
+    pad_rows = np.zeros(size, np.int64)
+    pad_rows[:N] = rows
+    px, py, pz = (provider._pk_px[pad_rows], provider._pk_py[pad_rows],
+                  provider._pk_pz[pad_rows])
+
+    def g2():
+        out = provider._kernels.g2_msm(
+            jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz),
+            jnp.asarray(bits))
+        jax.block_until_ready(out)
+        return out
+
+    (gax, gay, gainf), g2_dt = timeit("g2_msm", g2)
+
+    agg_sig = tp._affine_to_oracle_g1(ax, ay, ainf)
+    agg_pk = tp._affine_to_oracle_g2(gax, gay, gainf)
+    h_pt = oracle.hash_to_g1(h, b"")
+    neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+    timeit("pairing", lambda: oracle.multi_pairing_is_one(
+        [(agg_sig, neg_g2), (h_pt, agg_pk)]))
+    timeit("hash_to_g1", lambda: oracle.hash_to_g1(h, b""))
+
+    _, full_dt = timeit("full", lambda: provider.verify_batch(
+        sigs, [h] * N, pks), iters=2)
+    print(f"rate: {N / full_dt:.0f} verifies/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Multi-host (DCN) init: single-process degenerate path + global mesh.
+
+The real multi-process path needs a coordinator across machines; the CI
+environment has one host, so these tests pin the contract the launcher
+relies on: no-coordinator → clean single-process fallback, and the
+global mesh spans every (virtual) device in jax.devices() order."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from consensus_overlord_tpu.parallel import (  # noqa: E402
+    global_mesh, init_multihost, make_mesh)
+
+
+def test_init_without_coordinator_is_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert init_multihost() is False
+    assert jax.process_count() == 1
+
+
+def test_global_mesh_spans_all_devices():
+    mesh = global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert list(mesh.axis_names) == ["lanes"]
+    # host-major order: identical to jax.devices() (the documented
+    # ICI-first combine layout)
+    assert list(mesh.devices.ravel()) == list(jax.devices())
+
+
+def test_global_mesh_matches_make_mesh_shape():
+    m1, m2 = global_mesh(), make_mesh()
+    assert m1.devices.size == m2.devices.size

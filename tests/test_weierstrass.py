@@ -1,0 +1,177 @@
+"""secp256k1 / SM2: generic-a curve ops, dual-scalar MSM, providers.
+
+The host oracle is ops-independent python-int affine math (HostCurve);
+the secp256k1 ECDSA scheme is additionally cross-checked against the
+`cryptography` package in both directions."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from consensus_overlord_tpu.crypto.ecdsa_tpu import (  # noqa: E402
+    SECP_HOST, SM2_HOST, Secp256k1Crypto, Sm2Crypto)
+from consensus_overlord_tpu.ops import weierstrass as w  # noqa: E402
+from consensus_overlord_tpu.ops.curve import int_to_bits_msb  # noqa: E402
+
+CASES = [(w.SECP, SECP_HOST), (w.SM2, SM2_HOST)]
+
+
+def _dev_points(ops, host, scalars):
+    pts = [host.mul(k, host.g) for k in scalars]
+    f = ops.f
+    x = jnp.asarray(np.stack([f.from_int(p[0]) for p in pts]))
+    y = jnp.asarray(np.stack([f.from_int(p[1]) for p in pts]))
+    return ops.from_affine(x, y), pts
+
+
+def _affine_ints(ops, pt):
+    ax, ay, ainf = ops.to_affine(pt)
+    return [
+        None if bool(i) else (xv, yv)
+        for xv, yv, i in zip(ops.f.to_ints(ax), ops.f.to_ints(ay),
+                             np.asarray(ainf).reshape(-1))
+    ]
+
+
+@pytest.mark.parametrize("ops,host", CASES, ids=["secp256k1", "sm2"])
+def test_add_matches_host(ops, host):
+    ks = [1, 2, 3, 12345, host.n - 1]
+    p_dev, p_aff = _dev_points(ops, host, ks)
+    q_dev, q_aff = _dev_points(ops, host, list(reversed(ks)))
+    got = _affine_ints(ops, ops.add(p_dev, q_dev))
+    want = [host.add(a, b) for a, b in zip(p_aff, q_aff)]
+    assert got == want  # includes P + (−P): k + (n−k) = ∞ on lane pairs
+
+
+@pytest.mark.parametrize("ops,host", CASES, ids=["secp256k1", "sm2"])
+def test_dbl_and_identity(ops, host):
+    p_dev, p_aff = _dev_points(ops, host, [5, 77])
+    assert _affine_ints(ops, ops.dbl(p_dev)) == [
+        host.add(a, a) for a in p_aff]
+    inf = ops.infinity_like(p_dev.x)
+    assert _affine_ints(ops, ops.add(p_dev, inf)) == p_aff
+    assert bool(np.asarray(ops.is_infinity(inf)).all())
+
+
+@pytest.mark.parametrize("ops,host", CASES, ids=["secp256k1", "sm2"])
+def test_on_curve(ops, host):
+    p_dev, _ = _dev_points(ops, host, [9, 10])
+    assert bool(np.asarray(ops.on_curve(p_dev)).all())
+    bad = p_dev._replace(x=ops.f.add(p_dev.x, ops.f.one()))
+    assert not bool(np.asarray(ops.on_curve(bad)).any())
+
+
+@pytest.mark.parametrize("ops,host", CASES, ids=["secp256k1", "sm2"])
+def test_dual_scalar_mul(ops, host):
+    rng = np.random.default_rng(7)
+    u1s = [int.from_bytes(rng.bytes(32), "big") % host.n for _ in range(4)]
+    u2s = [int.from_bytes(rng.bytes(32), "big") % host.n for _ in range(4)]
+    u1s[3] = 0  # zero-scalar lane
+    q_dev, q_aff = _dev_points(ops, host, [3, 8, 1, 4])
+    f = ops.f
+    g = ops.from_affine(
+        jnp.asarray(f.from_int(host.g[0]))[None].astype(jnp.int32),
+        jnp.asarray(f.from_int(host.g[1]))[None].astype(jnp.int32))
+    got = _affine_ints(ops, w.dual_scalar_mul_bits(
+        ops, g, int_to_bits_msb(u1s, 256), q_dev, int_to_bits_msb(u2s, 256)))
+    want = [host.add(host.mul(u1, host.g), host.mul(u2, q))
+            for u1, u2, q in zip(u1s, u2s, q_aff)]
+    assert got == want
+
+
+# -- providers ---------------------------------------------------------------
+
+@pytest.mark.parametrize("cls", [Secp256k1Crypto, Sm2Crypto],
+                         ids=["secp256k1", "sm2"])
+def test_sign_verify_roundtrip(cls):
+    c = cls(0xC0FFEE)
+    h = c.hash(b"proposal")
+    sig = c.sign(h)
+    assert c.verify_signature(sig, h, c.pub_key)
+    assert not c.verify_signature(sig, c.hash(b"other"), c.pub_key)
+    bad = bytearray(sig)
+    bad[5] ^= 1
+    assert not c.verify_signature(bytes(bad), h, c.pub_key)
+    other = cls(0xBEEF)
+    assert not c.verify_signature(sig, h, other.pub_key)
+
+
+def test_secp256k1_low_s_rule():
+    c = Secp256k1Crypto(0xAB)
+    h = c.hash(b"vote")
+    sig = c.sign(h)
+    s = int.from_bytes(sig[32:], "big")
+    assert 2 * s <= SECP_HOST.n
+    high = sig[:32] + (SECP_HOST.n - s).to_bytes(32, "big")
+    assert not c.verify_signature(high, h, c.pub_key)  # one encoding only
+
+
+def test_secp256k1_cross_check_cryptography():
+    ec = pytest.importorskip(
+        "cryptography.hazmat.primitives.asymmetric.ec")
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed, decode_dss_signature, encode_dss_signature)
+
+    ours = SecP = Secp256k1Crypto(0x1DEA)
+    lib_sk = ec.derive_private_key(ours._sk, ec.SECP256K1())
+    lib_pk = lib_sk.public_key()
+    h = ours.hash(b"interop")
+
+    # ours → lib
+    sig = ours.sign(h)
+    der = encode_dss_signature(int.from_bytes(sig[:32], "big"),
+                               int.from_bytes(sig[32:], "big"))
+    lib_pk.verify(der, h, ec.ECDSA(Prehashed(hashes.SHA256())))
+
+    # lib → ours (normalized to the low-s form our verifier requires)
+    der2 = lib_sk.sign(h, ec.ECDSA(Prehashed(hashes.SHA256())))
+    r, s = decode_dss_signature(der2)
+    s = min(s, SECP_HOST.n - s)
+    sig2 = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    assert Secp256k1Crypto.verify_signature(
+        SecP, sig2, h, ours.pub_key)
+
+    # lib parses our compressed pubkey
+    ec.EllipticCurvePublicKey.from_encoded_point(
+        ec.SECP256K1(), ours.pub_key)
+
+
+@pytest.mark.parametrize("cls", [Secp256k1Crypto, Sm2Crypto],
+                         ids=["secp256k1", "sm2"])
+def test_device_verify_batch(cls):
+    signers = [cls(0x5000 + 13 * i, device_threshold=4) for i in range(6)]
+    verifier = signers[0]
+    hashes = [verifier.hash(bytes([i])) for i in range(6)]
+    sigs = [s.sign(h) for s, h in zip(signers, hashes)]
+    voters = [s.pub_key for s in signers]
+
+    assert verifier.verify_batch(sigs, hashes, voters) == [True] * 6
+
+    # corrupt lanes: flipped sig byte, wrong hash, swapped voter,
+    # malformed voter, short sig
+    bad_sigs = list(sigs)
+    bad_sigs[1] = sigs[1][:5] + bytes([sigs[1][5] ^ 1]) + sigs[1][6:]
+    bad_hashes = list(hashes)
+    bad_hashes[2] = verifier.hash(b"nope")
+    bad_voters = list(voters)
+    bad_voters[3] = voters[4]
+    bad_voters[5] = b"\x02" + b"\xff" * 32
+    got = verifier.verify_batch(bad_sigs, bad_hashes, bad_voters)
+    assert got == [True, False, False, False, True, False]
+
+
+@pytest.mark.parametrize("cls", [Secp256k1Crypto, Sm2Crypto],
+                         ids=["secp256k1", "sm2"])
+def test_aggregate_roundtrip(cls):
+    signers = [cls(0x7000 + 31 * i, device_threshold=4) for i in range(5)]
+    v = signers[0]
+    h = v.hash(b"qc")
+    sigs = [s.sign(h) for s in signers]
+    voters = [s.pub_key for s in signers]
+    agg = v.aggregate_signatures(sigs, voters)
+    assert v.verify_aggregated_signature(agg, h, voters)
+    assert not v.verify_aggregated_signature(agg, v.hash(b"x"), voters)
+    assert not v.verify_aggregated_signature(agg[:-1], h, voters)
+    assert not v.verify_aggregated_signature(agg, h, [])
