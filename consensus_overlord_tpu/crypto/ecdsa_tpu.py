@@ -45,7 +45,7 @@ from ..core.sm3 import sm3_hash
 _enable_compile_cache()
 
 from ..ops import weierstrass as w
-from ..ops.curve import int_to_bits_msb
+from ..ops.curve import int_to_bits_msb_np
 from .provider import CryptoError
 from .tpu_provider import _pad_to
 
@@ -194,15 +194,19 @@ SM2_HOST = HostCurve(w.SM2_P, w.SM2_A, w.SM2_B, w.SM2_N,
                      w.SM2_GX, w.SM2_GY)
 
 
-def _det_nonce(sk: int, e: int, n: int) -> int:
-    """Deterministic nonce: k = SM3(sk ‖ e ‖ ctr) chained until nonzero
-    mod n (RFC 6979-shaped; exact RFC HMAC-DRBG construction not needed
-    for the sim fleet, and the scheme never reuses k across messages)."""
+def _det_nonce(sk: int, e: int, n: int, retry: int = 0) -> int:
+    """Deterministic nonce: k = SM3(sk ‖ e ‖ retry ‖ ctr) chained until
+    nonzero mod n (RFC 6979-shaped; exact RFC HMAC-DRBG construction not
+    needed for the sim fleet).  `retry` is the signer's degenerate-r/s
+    retry index and `ctr` absorbs zero-k draws — both live in their own
+    hash-input fields, so a retried nonce can never collide with any
+    message's first-try nonce (k is never reused across messages)."""
     ctr = 0
     while True:
         k = int.from_bytes(
-            sm3_hash(sk.to_bytes(32, "big") + e.to_bytes(32, "big")
-                     + ctr.to_bytes(4, "big")), "big") % n
+            sm3_hash(sk.to_bytes(32, "big") + (e % 2**256).to_bytes(32, "big")
+                     + retry.to_bytes(4, "big") + ctr.to_bytes(4, "big")),
+            "big") % n
         if k:
             return k
         ctr += 1
@@ -344,7 +348,7 @@ class _EcdsaFamilyCrypto:
 
         def bits_of(vals):
             out = np.zeros((size, _SCALAR_BITS), np.int32)
-            out[:n] = np.asarray(int_to_bits_msb(vals, _SCALAR_BITS))
+            out[:n] = int_to_bits_msb_np(vals, _SCALAR_BITS)
             return jnp.asarray(out)
 
         def limbs_of(vals):
@@ -431,9 +435,8 @@ class Secp256k1Crypto(_EcdsaFamilyCrypto):
     def sign(self, hash32: bytes) -> bytes:
         host = self.host
         e = int.from_bytes(hash32, "big") % host.n
-        ctr_e = e
-        while True:
-            k = _det_nonce(self._sk, ctr_e, host.n)
+        for retry in range(2**31):
+            k = _det_nonce(self._sk, e, host.n, retry)
             r_pt = host.mul(k, host.g)
             r = r_pt[0] % host.n
             s = (e + r * self._sk) * pow(k, host.n - 2, host.n) % host.n
@@ -441,7 +444,7 @@ class Secp256k1Crypto(_EcdsaFamilyCrypto):
                 if 2 * s > host.n:
                     s = host.n - s  # low-s normal form
                 return r.to_bytes(32, "big") + s.to_bytes(32, "big")
-            ctr_e += 1  # pathological nonce; re-derive
+        raise CryptoError("nonce derivation failed")  # unreachable
 
     def _scalars_of(self, sig, hash32):
         host = self.host
@@ -469,19 +472,17 @@ class Sm2Crypto(_EcdsaFamilyCrypto):
         host = self.host
         e = int.from_bytes(hash32, "big")
         inv_1sk = pow(1 + self._sk, host.n - 2, host.n)
-        ctr_e = e
-        while True:
-            k = _det_nonce(self._sk, ctr_e % 2**256, host.n)
+        for retry in range(2**31):
+            k = _det_nonce(self._sk, e, host.n, retry)
             x1 = host.mul(k, host.g)[0]
             r = (e + x1) % host.n
             if r == 0 or r + k == host.n:
-                ctr_e += 1
                 continue
             s = inv_1sk * (k - r * self._sk) % host.n
             if s == 0:
-                ctr_e += 1
                 continue
             return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+        raise CryptoError("nonce derivation failed")  # unreachable
 
     def _scalars_of(self, sig, hash32):
         host = self.host

@@ -233,14 +233,20 @@ class CurveOps:
                 self.is_infinity(p))
 
 
-def int_to_bits_msb(values: Sequence[int], nbits: int) -> jnp.ndarray:
-    """Host helper: ints → (len, nbits) MSB-first int32 bit array for
-    scalar_mul_bits.  np.unpackbits over the big-endian byte form — a
+def int_to_bits_msb_np(values: Sequence[int], nbits: int):
+    """Host helper: ints → (len, nbits) MSB-first int32 NUMPY bit array
+    for scalar_mul_bits.  np.unpackbits over the big-endian byte form — a
     Python double loop here costs ~100 ms per 1024×128 batch, squarely in
-    the verify hot path."""
+    the verify hot path.  Callers that slot the bits into a padded host
+    buffer before upload use this form directly: wrapping in jnp first
+    would cost a device->host->device round-trip per call."""
     import numpy as np
     nbytes = -(-nbits // 8)
     packed = b"".join(v.to_bytes(nbytes, "big") for v in values)
     arr = np.frombuffer(packed, np.uint8).reshape(len(values), nbytes)
-    bits = np.unpackbits(arr, axis=1)[:, nbytes * 8 - nbits:]
-    return jnp.asarray(bits.astype(np.int32))
+    return np.unpackbits(arr, axis=1)[:, nbytes * 8 - nbits:].astype("int32")
+
+
+def int_to_bits_msb(values: Sequence[int], nbits: int) -> jnp.ndarray:
+    """Device-array form of int_to_bits_msb_np."""
+    return jnp.asarray(int_to_bits_msb_np(values, nbits))

@@ -211,9 +211,10 @@ def parse_points(blobs: Sequence[bytes]) -> ParsedEd:
 
 
 def int_to_bits_msb(values: Sequence[int], nbits: int) -> np.ndarray:
-    """MSB-first bit matrix — shared helper, see ops/curve.py."""
-    from .curve import int_to_bits_msb as _impl
-    return np.asarray(_impl(values, nbits))
+    """MSB-first bit matrix (numpy — callers slot into padded host
+    buffers) — shared helper, see ops/curve.py."""
+    from .curve import int_to_bits_msb_np as _impl
+    return _impl(values, nbits)
 
 
 # ---------------------------------------------------------------------------

@@ -345,8 +345,14 @@ class FieldSpec:
     def to_ints(self, x: Array) -> List[int]:
         """Host-side: canonical integer values of a (..., n) limb array,
         flattened C-order."""
-        arr = np.asarray(jax.device_get(self.strict(x)), dtype=np.int64)
-        flat = arr.reshape(-1, self.n)
+        return self.ints_from_strict(jax.device_get(self.strict(x)))
+
+    def ints_from_strict(self, arr) -> List[int]:
+        """Pure-numpy decode of already-canonical strict digits — no
+        device dispatch.  Kernels that return strict() outputs pair with
+        this so reading a result costs zero extra device round-trips
+        (each round-trip is ~100 ms over a remote PJRT link)."""
+        flat = np.asarray(arr, dtype=np.int64).reshape(-1, self.n)
         return [int(sum(int(d) << (self.b * i) for i, d in enumerate(row)))
                 for row in flat]
 

@@ -20,4 +20,5 @@ from .sharded import (  # noqa: F401
     sharded_g2_sum,
     sharded_g2_validate,
     sharded_round_step,
+    sharded_verify_round,
 )

@@ -87,7 +87,36 @@ def sharded_g1_verify_msm(mesh: Mesh, axis: str = AXIS):
         partial_sum, valid = _g1_local_msm(x, sign, inf, ok, bits)
         total = _combine_replicated(dev.G1, partial_sum, axis)
         ax, ay, ainf = dev.G1.to_affine(total)
-        return ax[0], ay[0], ainf[0], valid
+        return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid
+
+    return jax.jit(fn)
+
+
+def sharded_verify_round(mesh: Mesh, axis: str = AXIS):
+    """The fused single-dispatch verification step over the mesh (the
+    sharded twin of tpu_provider.verify_round_fn): lanes shard, each
+    device validates + locally reduces its G1/G2 shards, partials combine
+    over ICI, and every device runs the same aggregate subgroup check —
+    one SPMD program, strict replicated outputs, sharded validity."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis),) * 8,
+             out_specs=(P(), P(), P(), P(axis), P(), P(), P(), P()))
+    def fn(x, sign, inf, ok, bits, px, py, pz):
+        pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
+        valid = valid & ~inf
+        pt = dev.G1.select(valid, pt, dev.G1.infinity_like(x))
+        agg = _combine_replicated(
+            dev.G1, dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits)), axis)
+        sub_ok = dev.g1_agg_subgroup_check(agg)[0]
+        ax, ay, ainf = dev.G1.to_affine(agg)
+        vbits = bits * valid[..., None].astype(bits.dtype)
+        gagg = _combine_replicated(
+            dev.G2, dev.G2.tree_sum(
+                dev.G2.scalar_mul_bits(Point(px, py, pz), vbits)), axis)
+        gx, gy, ginf = dev.G2.to_affine(gagg)
+        return (dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid,
+                sub_ok, dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0])
 
     return jax.jit(fn)
 
@@ -103,7 +132,7 @@ def sharded_g2_msm(mesh: Mesh, axis: str = AXIS):
             dev.G2.scalar_mul_bits(Point(px, py, pz), bits))
         total = _combine_replicated(dev.G2, local, axis)
         ax, ay, ainf = dev.G2.to_affine(total)
-        return ax[0], ay[0], ainf[0]
+        return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0]
 
     return jax.jit(fn)
 
@@ -138,7 +167,7 @@ def sharded_g1_validate_sum(mesh: Mesh, axis: str = AXIS):
             dev.G1.select(valid & ~inf, pt, dev.G1.infinity_like(x)))
         total = _combine_replicated(dev.G1, local, axis)
         ax, ay, ainf = dev.G1.to_affine(total)
-        return ax[0], ay[0], ainf[0], valid
+        return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid
 
     return jax.jit(fn)
 
@@ -154,7 +183,7 @@ def sharded_g2_sum(mesh: Mesh, axis: str = AXIS):
         local = dev.G2.tree_sum(Point(px, py, pz))
         total = _combine_replicated(dev.G2, local, axis)
         ax, ay, ainf = dev.G2.to_affine(total)
-        return ax[0], ay[0], ainf[0]
+        return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0]
 
     return jax.jit(fn)
 

@@ -42,7 +42,10 @@ def main() -> None:
         if args.tpu:
             from ..crypto.tpu_provider import TpuBlsCrypto
 
-            factory = lambda i: TpuBlsCrypto(0x1000 + 7919 * i)  # noqa: E731
+            # threshold 8: batches actually reach the device even in
+            # small fleets, keeping the reported "tpu" field truthful
+            factory = lambda i: TpuBlsCrypto(  # noqa: E731
+                0x1000 + 7919 * i, device_threshold=8)
         else:
             from ..crypto.provider import CpuBlsCrypto
 
@@ -63,7 +66,7 @@ def main() -> None:
         from ..crypto.ed25519_tpu import Ed25519TpuCrypto
 
         factory = lambda i: Ed25519TpuCrypto(  # noqa: E731
-            (0x4000 + 7919 * i).to_bytes(32, "big"))
+            (0x4000 + 7919 * i).to_bytes(32, "big"), device_threshold=8)
     else:
         factory = None
 

@@ -44,26 +44,11 @@ def main():
     from consensus_overlord_tpu.ops import bls12381_groups as dev
 
     print(f"device: {jax.devices()[0].platform}  N={N}")
-    h = sm3_hash(b"profile")
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                         ".bench_fixture.npz")
-    if os.path.exists(cache):
-        data = np.load(cache)
-        if data["sigs"].shape[0] >= N:
-            sigs = [bytes(r) for r in data["sigs"][:N]]
-            pks = [bytes(r) for r in data["pks"][:N]]
-        else:
-            sigs = pks = None
-    else:
-        sigs = pks = None
-    if sigs is None:
-        h2 = sm3_hash(b"bench-block-hash")
-        sks = [0xBEEF + 97 * i for i in range(N)]
-        sigs = [oracle.sign(sk, h2) for sk in sks]
-        pks = [oracle.sk_to_pk(sk) for sk in sks]
-        h = h2
-    else:
-        h = sm3_hash(b"bench-block-hash")
+    # Reuse bench.py's fixture (same cache file + message) so the two
+    # tools can never drift apart on what they measure.
+    import bench
+    bench.N = N
+    sigs, h, pks = bench._fixture()
 
     provider = tp.TpuBlsCrypto(0xA11CE)
     provider.update_pubkeys(pks)

@@ -115,3 +115,36 @@ class TestProviderSurface:
 
     def test_hash_is_sm3(self, tpu):
         assert tpu.hash(b"abc") == sm3_hash(b"abc")
+
+
+class TestSubgroupAttack:
+    def test_non_subgroup_signature_lane_rejected(self, cpus, tpu):
+        """An on-curve G1 point OUTSIDE the r-torsion subgroup (cofactor
+        component) must fail, and must not poison the honest lanes.  This
+        drives the batched-by-linearity check (g1_agg_subgroup_check):
+        the aggregate residual fires, the provider falls back to exact
+        per-lane checks, and only the torsioned lane dies."""
+        from consensus_overlord_tpu.crypto import bls12381 as oracle
+
+        x = 7
+        pt = None
+        while pt is None:
+            rhs = (pow(x, 3, oracle.P) + 4) % oracle.P
+            y = pow(rhs, (oracle.P + 1) // 4, oracle.P)
+            if y * y % oracle.P == rhs:
+                cand = (x, y)
+                if not oracle.g1_in_subgroup(cand):
+                    pt = cand
+            x += 1
+        rogue = oracle.g1_compress(pt)
+
+        sigs, hashes, voters = make_votes(cpus)
+        sigs[4] = rogue
+        got = tpu.verify_batch(sigs, hashes, voters)
+        assert got == [True, True, True, True, False, True]
+
+    def test_all_honest_subgroup_check_passes(self, cpus, tpu):
+        """Sanity twin: with honest lanes the aggregate check must NOT
+        fire (no silent fallback-to-host on the hot path)."""
+        sigs, hashes, voters = make_votes(cpus, msg=b"block-hash-sub")
+        assert tpu.verify_batch(sigs, hashes, voters) == [True] * N

@@ -114,7 +114,7 @@ def test_secp256k1_cross_check_cryptography():
     from cryptography.hazmat.primitives.asymmetric.utils import (
         Prehashed, decode_dss_signature, encode_dss_signature)
 
-    ours = SecP = Secp256k1Crypto(0x1DEA)
+    ours = Secp256k1Crypto(0x1DEA)
     lib_sk = ec.derive_private_key(ours._sk, ec.SECP256K1())
     lib_pk = lib_sk.public_key()
     h = ours.hash(b"interop")
@@ -130,8 +130,7 @@ def test_secp256k1_cross_check_cryptography():
     r, s = decode_dss_signature(der2)
     s = min(s, SECP_HOST.n - s)
     sig2 = r.to_bytes(32, "big") + s.to_bytes(32, "big")
-    assert Secp256k1Crypto.verify_signature(
-        SecP, sig2, h, ours.pub_key)
+    assert ours.verify_signature(sig2, h, ours.pub_key)
 
     # lib parses our compressed pubkey
     ec.EllipticCurvePublicKey.from_encoded_point(
