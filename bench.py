@@ -26,8 +26,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-N = int(os.environ.get("BENCH_N", "1024"))       # votes per round-batch
-ITERS = int(os.environ.get("BENCH_ITERS", "4"))  # timed iterations
+# 4096 votes/batch: large enough to amortize the ~200 ms dispatch→read
+# round-trip of the remote PJRT link (a 10k-validator round needs batches
+# of this scale anyway); override with BENCH_N for other points.
+N = int(os.environ.get("BENCH_N", "4096"))       # votes per round-batch
+ITERS = int(os.environ.get("BENCH_ITERS", "3"))  # timed iterations
 CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".bench_fixture.npz")
 
@@ -48,9 +51,9 @@ def _fixture():
     h = sm3_hash(b"bench-block-hash")
     if os.path.exists(CACHE):
         data = np.load(CACHE)
-        if data["sigs"].shape[0] == N:
-            sigs = [bytes(r) for r in data["sigs"]]
-            pks = [bytes(r) for r in data["pks"]]
+        if data["sigs"].shape[0] >= N:  # slice a larger cache, keep it
+            sigs = [bytes(r) for r in data["sigs"][:N]]
+            pks = [bytes(r) for r in data["pks"][:N]]
             return sigs, h, pks
     sks = [0xBEEF + 97 * i for i in range(N)]
     sigs = [oracle.sign(sk, h) for sk in sks]
@@ -82,8 +85,29 @@ def main():
     t0 = time.time()
     for _ in range(ITERS):
         result = provider.verify_batch(sigs, hashes, pks)
-    elapsed = time.time() - t0
-    rate = N * ITERS / elapsed
+    sync_rate = N * ITERS / (time.time() - t0)
+
+    # Steady-state (pipelined) throughput: the consensus vote stream is
+    # continuous, so batch k+1 dispatches while batch k's readback +
+    # pairing completes — verify_batch_async overlaps the ~200 ms
+    # dispatch→readback round-trip of the remote PJRT link with device
+    # compute.  Depth-2 software pipeline, resolved in order.
+    depth = 2
+    t0 = time.time()
+    inflight = []
+    done = 0
+    ok = True
+    for _ in range(2 * ITERS):
+        inflight.append(provider.verify_batch_async(sigs, hashes, pks))
+        if len(inflight) >= depth:
+            ok &= all(inflight.pop(0)())
+            done += 1
+    while inflight:
+        ok &= all(inflight.pop(0)())
+        done += 1
+    rate = N * done / (time.time() - t0)
+    if not ok:
+        raise SystemExit("pipelined bench batch failed verification")
 
     # Context rates (stderr): this repo's own CPU paths, single thread.
     k = 8
@@ -105,6 +129,8 @@ def main():
     print(json.dumps({
         "context": {
             "batch": N, "iters": ITERS,
+            "sync_verifies_per_s": round(sync_rate, 2),
+            "pipelined_verifies_per_s": round(rate, 2),
             cpu_key: round(cpu_best, 2),
             "cpu_pure_python_pairings_per_s":
                 round(pure, 2) if pure else None,

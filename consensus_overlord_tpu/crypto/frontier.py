@@ -119,9 +119,27 @@ class BatchingVerifier:
         hashes = [b[1] for b in batch]
         voters = [b[2] for b in batch]
         try:
-            # Device dispatch blocks; keep the event loop live under it.
-            results = await asyncio.to_thread(
-                self._provider.verify_batch, sigs, hashes, voters)
+            verify_async = getattr(self._provider, "verify_batch_async",
+                                   None)
+            if verify_async is not None:
+                # Unseen pubkeys trigger a blocking device validation
+                # round-trip inside prep — run that warmup off-loop
+                # first (cold cache / post-reconfiguration only).
+                warm = getattr(self._provider, "warm_pubkeys", None)
+                if warm is not None:
+                    await asyncio.to_thread(warm, voters)
+                # Then dispatch on the loop thread (cheap host prep +
+                # async device enqueue), and block only for the readback
+                # in a worker thread: consecutive flushes overlap the
+                # ~200 ms dispatch→readback round-trip of a remote PJRT
+                # link with device compute (deterministic pipelining —
+                # thread-pool scheduling doesn't decide dispatch order).
+                resolver = verify_async(sigs, hashes, voters)
+                results = await asyncio.to_thread(resolver)
+            else:
+                # Device dispatch blocks; keep the event loop live.
+                results = await asyncio.to_thread(
+                    self._provider.verify_batch, sigs, hashes, voters)
         except Exception:  # noqa: BLE001 — malformed input is never fatal
             logger.exception("frontier batch verification errored")
             results = [False] * len(batch)

@@ -95,28 +95,37 @@ def verify_round_fn(x, sign, inf, ok, bits, px, py, pz):
     costs ~100 ms over a remote PJRT link, which dominated the measured
     batch time):
 
-      G1: decompress + validate signatures, Σ r_i·S_i
-      subgroup: φ(A) == [λ]A on the aggregate — the batched-by-linearity
-        check (ops/bls12381_groups.g1_agg_subgroup_check) replacing the
-        per-lane ladder that was ~60% of the old kernel's point ops
+      G1: decompress + validate + per-lane fast subgroup check of the
+        signatures, then Σ r_i·S_i
       G2: Σ r_i·P_i over the gathered pubkey rows, weights masked by the
         device-computed validity so both sides of the pairing relation
         see the same lane set
 
-    Returns strict (numpy-decodable) affine coords for both aggregates,
-    the per-lane validity, and the scalar subgroup-check flag.
+    The subgroup check must stay PER-LANE.  A batched-by-linearity form
+    (check φ(A) == [λ]A on the aggregate only) is unsound: the G1
+    cofactor is 3 · 11² · 10177² · …, so the per-lane residuals live in
+    a group with small subgroups — a signature carrying the order-3
+    point (0, 2) cancels out of the aggregate whenever its random weight
+    is ≡ 0 (mod 3) (probability 1/3), and two colluding lanes cancel
+    deterministically for ANY weight distribution.  A probabilistic
+    accept of a non-subgroup signature that the host oracle rejects
+    would split honest validators — consensus requires deterministic
+    accept sets.  (tests/test_tpu_provider.py::TestSubgroupAttack pins
+    both the random-cofactor and the order-3-component attacks.)
+
+    Returns strict (numpy-decodable) affine coords for both aggregates
+    plus the per-lane validity.
     """
     pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
-    valid = valid & ~inf
+    valid = valid & ~inf & dev.g1_in_subgroup(pt)
     pt = dev.G1.select(valid, pt, dev.G1.infinity_like(x))
     agg = dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits))
-    sub_ok = dev.g1_agg_subgroup_check(agg)[0]
     ax, ay, ainf = dev.G1.to_affine(agg)
     vbits = bits * valid[..., None].astype(bits.dtype)
     gagg = dev.G2.tree_sum(dev.G2.scalar_mul_bits(Point(px, py, pz), vbits))
     gx, gy, ginf = dev.G2.to_affine(gagg)
     return (dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid,
-            sub_ok, dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0])
+            dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0])
 
 
 _verify_round = jax.jit(verify_round_fn)
@@ -340,39 +349,16 @@ class TpuBlsCrypto:
             return [self._cpu.verify_signature(s, h, v)
                     for s, h, v in zip(signatures, hashes, voters)]
 
-        # Pubkeys: validate (cached) and gather device rows.
-        pk_idx = self._pk_rows_of(voters)
-        pk_ok = pk_idx >= 0
-
-        size = self._pad_to(n)
-        parsed = dev.parse_g1_compressed(list(signatures))
-        sx = np.zeros((size, dev.FQ.n), np.int32)
-        sx[:n] = parsed.x
-        ssign = np.zeros(size, bool)
-        ssign[:n] = parsed.sign
-        sinf = np.zeros(size, bool)
-        sinf[:n] = parsed.infinity
-        sok = np.zeros(size, bool)
-        # lanes with bad pubkeys are disabled entirely
-        sok[:n] = parsed.wellformed & pk_ok
-
-        # Random _SCALAR_BITS-wide weights (top bit forced: nonzero);
-        # padding lanes get weight 0.  One vectorized unpackbits, not a
-        # Python double loop (which costs ~100 ms per 1024-lane batch).
-        packed = np.frombuffer(
-            secrets.token_bytes(n * _SCALAR_BITS // 8),
-            np.uint8).reshape(n, _SCALAR_BITS // 8).copy()
-        packed[:, 0] |= 0x80  # force the top bit: scalars nonzero
-        bits = np.zeros((size, _SCALAR_BITS), np.int32)
-        bits[:n] = np.unpackbits(packed, axis=1)
+        (size, sx, ssign, sinf, sok, bits,
+         pk_idx, pk_ok) = self._host_prep(signatures, voters, n)
 
         # Fast path — all lanes vote on ONE hash (the consensus common
-        # case): a single fused dispatch computes both MSMs, the validity
-        # mask, and the batched subgroup check.
+        # case): a single fused dispatch computes both MSMs and the
+        # per-lane validity (incl. subgroup checks).
         if len(set(map(bytes, hashes))) == 1:
-            return self._verify_single_hash(
+            return self._dispatch_single_hash(
                 signatures, bytes(hashes[0]), voters, n, size,
-                sx, ssign, sinf, sok, bits, pk_idx, pk_ok)
+                sx, ssign, sinf, sok, bits, pk_idx, pk_ok)()
 
         ax, ay, ainf, valid = jax.device_get(self._kernels.g1_validate_msm(
             jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
@@ -416,42 +402,101 @@ class TpuBlsCrypto:
                     signatures[i], hashes[i], voters[i])
                 for i in range(n)]
 
+    def verify_batch_async(self, signatures: Sequence[bytes],
+                           hashes: Sequence[bytes],
+                           voters: Sequence[bytes]):
+        """Pipelined form of verify_batch: dispatches the device work NOW
+        and returns a zero-argument `resolve()` that blocks on the result
+        and finishes host-side (pairing / fallback).
+
+        The dispatch→readback round-trip on a remote PJRT link is ~200 ms
+        regardless of batch size; issuing batch k+1 before resolving
+        batch k overlaps that latency with device compute (measured 1.5x
+        throughput at depth 4–8).  The engine's vote stream is exactly
+        such a pipeline: the frontier can flush the next coalesced batch
+        while the previous one's pairing finishes."""
+        n = len(signatures)
+        assert len(hashes) == n and len(voters) == n
+        single = n > 0 and len(set(map(bytes, hashes))) == 1
+        if n == 0 or n < self._threshold or not single:
+            # Below-threshold and multi-hash batches take the sync path,
+            # LAZILY: the frontier calls resolve() off the event loop, so
+            # the blocking device work must happen there, not here.
+            return lambda: self.verify_batch(signatures, hashes, voters)
+        prep = self._host_prep(signatures, voters, n)
+        return self._dispatch_single_hash(
+            signatures, bytes(hashes[0]), voters, n, *prep[:6],
+            prep[6], prep[7])
+
     # -- internals -----------------------------------------------------------
 
-    def _verify_single_hash(self, signatures, h: bytes, voters, n, size,
-                            sx, ssign, sinf, sok, bits, pk_idx, pk_ok
-                            ) -> List[bool]:
-        """One fused device dispatch for the single-hash batch: both MSMs
-        (G2 weights masked on-device by the same validity the G1 side
-        uses), strict outputs, and the aggregate subgroup check."""
+    def _host_prep(self, signatures, voters, n):
+        """Shared host-side prep for BOTH the sync and async batch paths
+        (one copy: the two paths must verify under identical parsing,
+        padding, and RLC weight distributions or they drift apart):
+        parse + pad signature fields, validate/cache pubkeys, draw
+        weights.  Returns (size, sx, ssign, sinf, sok, bits, pk_idx,
+        pk_ok)."""
+        # Pubkeys: validate (cached) and gather device rows.
+        pk_idx = self._pk_rows_of(voters)
+        pk_ok = pk_idx >= 0
+        size = self._pad_to(n)
+        parsed = dev.parse_g1_compressed(list(signatures))
+        sx = np.zeros((size, dev.FQ.n), np.int32)
+        sx[:n] = parsed.x
+        ssign = np.zeros(size, bool)
+        ssign[:n] = parsed.sign
+        sinf = np.zeros(size, bool)
+        sinf[:n] = parsed.infinity
+        sok = np.zeros(size, bool)
+        # lanes with bad pubkeys are disabled entirely
+        sok[:n] = parsed.wellformed & pk_ok
+        # Random _SCALAR_BITS-wide weights (top bit forced: nonzero);
+        # padding lanes get weight 0.  One vectorized unpackbits, not a
+        # Python double loop (which costs ~100 ms per 1024-lane batch).
+        packed = np.frombuffer(
+            secrets.token_bytes(n * _SCALAR_BITS // 8),
+            np.uint8).reshape(n, _SCALAR_BITS // 8).copy()
+        packed[:, 0] |= 0x80  # force the top bit: scalars nonzero
+        bits = np.zeros((size, _SCALAR_BITS), np.int32)
+        bits[:n] = np.unpackbits(packed, axis=1)
+        return size, sx, ssign, sinf, sok, bits, pk_idx, pk_ok
+
+    def _dispatch_single_hash(self, signatures, h, voters, n, size,
+                              sx, ssign, sinf, sok, bits, pk_idx, pk_ok):
+        """Dispatch the fused kernel; return resolve() → List[bool]."""
         pad_rows = np.zeros(size, np.int64)
         pad_rows[:n] = np.maximum(pk_idx, 0)  # bad-key lanes: sok=False
         px = self._pk_px[pad_rows]
         py = self._pk_py[pad_rows]
         pz = self._pk_pz[pad_rows]
-        # ONE device_get: separate per-output reads would each pay a
-        # blocking D2H round-trip (~150 ms over a remote PJRT link) —
-        # measured at 840 ms of the 1.1 s batch before this was fused.
-        ax, ay, ainf, valid, sub_ok, gx, gy, ginf = jax.device_get(
-            self._kernels.verify_round(
-                jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-                jnp.asarray(sok), jnp.asarray(bits), jnp.asarray(px),
-                jnp.asarray(py), jnp.asarray(pz)))
-        valid = valid[:n] & pk_ok
-        if not valid.any():
-            return [False] * n
-        if bool(sub_ok):
+        out = self._kernels.verify_round(
+            jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+            jnp.asarray(sok), jnp.asarray(bits), jnp.asarray(px),
+            jnp.asarray(py), jnp.asarray(pz))
+
+        def resolve() -> List[bool]:
+            # ONE device_get: separate per-output reads would each pay a
+            # blocking D2H round-trip (~150 ms over a remote PJRT link) —
+            # measured at 840 ms of the 1.1 s batch before this was fused.
+            ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
+            v = valid[:n] & pk_ok
+            if not v.any():
+                return [False] * n
             agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
             agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
             h_pt = oracle.hash_to_g1(h, self._common_ref)
-            neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+            neg_g2 = (oracle.G2_GEN[0],
+                      oracle.fq2_neg(oracle.G2_GEN[1]))
             if oracle.multi_pairing_is_one([(agg_sig, neg_g2),
                                             (h_pt, agg_pk)]):
-                return list(valid)
-        # Subgroup or batch relation failed: exact per-lane localization.
-        return [bool(valid[i]) and self._verify_one_cached(
-                    signatures[i], h, voters[i])
-                for i in range(n)]
+                return list(v)
+            # Batch relation failed: exact per-lane localization.
+            return [bool(v[i]) and self._verify_one_cached(
+                        signatures[i], h, voters[i])
+                    for i in range(n)]
+
+        return resolve
 
     def _verify_one_cached(self, sig: bytes, hash32: bytes,
                            voter: bytes) -> bool:
@@ -480,6 +525,13 @@ class TpuBlsCrypto:
         if not missing:
             return
         self.update_pubkeys(missing)
+
+    def warm_pubkeys(self, voters: Sequence[bytes]) -> None:
+        """Validate-and-cache any unseen voter pubkeys now.  Callers on
+        an event loop (the frontier) run this in a worker thread before
+        dispatching, so the blocking device round-trip of a cold cache
+        never stalls the loop."""
+        self._ensure_pubkeys(voters)
 
     def update_pubkeys(self, voters: Sequence[bytes]) -> None:
         """Validate and cache a validator set's public keys — the analog of

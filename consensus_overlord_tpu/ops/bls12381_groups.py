@@ -26,7 +26,13 @@ from .curve import CurveOps, Point
 from .field import BLS12_381_FQ, Array
 from .fq2 import Fq2Ops
 
-FQ = BLS12_381_FQ
+# CONSENSUS_PALLAS=1 swaps the field multiplier under every BLS group op
+# for the Mosaic-compiled Pallas kernel (ops/pallas_field.py) — measured
+# ~1.0x the XLA path on v5-lite, kept as the scaffold for deeper fusion.
+from . import pallas_field as _pallas
+
+FQ = (_pallas.PallasField(BLS12_381_FQ) if _pallas.enabled()
+      else BLS12_381_FQ)
 FQ2 = Fq2Ops(FQ)
 
 # b = 4  →  b3 = 12;   b' = 4(1+u)  →  b3' = 12(1+u)
@@ -218,18 +224,15 @@ def g2_in_subgroup(p: Point) -> Array:
     return G2.eq(g2_endomorphism(p), zq) & G2.on_curve(p)
 
 
-def g1_agg_subgroup_check(agg: Point) -> Array:
-    """Batched-by-linearity subgroup check on an RLC aggregate: φ is a
-    group endomorphism, so for A = Σ r_i·S_i (on-curve S_i, random secret
-    r_i) the residual Σ r_i·(φ(S_i) − [λ]S_i) equals φ(A) − [λ]A.  If
-    every S_i ∈ G1 it is zero; if any S_i has a cofactor component it is
-    nonzero except with probability ≤ 2⁻⁶³ over the weights (same bound
-    as the batch relation itself, and the same remedy: callers fall back
-    to exact per-lane checks when this fires).  One 127-bit ladder on ONE
-    point replaces a per-lane ladder — the per-lane check was ~60% of the
-    verify kernel's point ops.  Infinity passes (φ(𝒪) = [λ]𝒪)."""
-    z2a = G1.scalar_mul_static(agg, Z_ABS * Z_ABS)
-    return G1.eq(g1_endomorphism(agg), G1.neg(z2a))
+# NOTE: there is deliberately NO batched-by-linearity subgroup check
+# (φ(ΣrᵢSᵢ) == [λ]ΣrᵢSᵢ) here.  It looks sound — φ is linear and the
+# per-lane residuals φ(Sᵢ)−[λ]Sᵢ vanish iff Sᵢ ∈ G1 — but the residuals
+# live in a group whose exponent has small prime factors (the G1
+# cofactor is 3 · 11² · 10177² · …, and E(Fp) contains the order-3 point
+# (0, 2)), so a random linear combination over them cancels with
+# probability 1/3 for a single 3-torsion lane and deterministically for
+# two colluding lanes.  Subgroup checks must stay per-lane; the attack
+# is pinned by tests/test_tpu_provider.py::TestSubgroupAttack.
 
 
 def g1_in_subgroup_full(p: Point) -> Array:

@@ -1,0 +1,146 @@
+"""Pallas TPU kernel for batched prime-field multiplication.
+
+The XLA path (ops/field.py FieldSpec.mul) expresses the limb convolution
+as 39 shifted pads + adds on (B, n) arrays — limbs on the 128-wide lane
+axis, of which only n≈39 are used (~30% lane utilization), and every
+intermediate is an XLA-fusion decision.  This kernel flips the layout:
+**batch on lanes, limbs on sublanes** — a (n, BT) block uses all 128
+lanes at any batch tile ≥ 128 — and keeps the whole product + reduction
+pipeline (conv → fold → carry, the exact statically-planned step list
+from FieldSpec._plan, same overflow-freedom theorem) in VMEM registers.
+
+This is SURVEY.md §7 step 4's "Pallas kernel" slot, built as a drop-in
+alternative backend: `mul_transposed(spec)` returns a jitted
+(n, B)-layout multiplier, `PallasField` wraps a FieldSpec so CurveOps
+can run whole point formulas in the transposed layout.  Whether it beats
+XLA's own scheduling is an empirical question per shape — see
+scripts/bench_pallas.py; the provider keeps the XLA path as default and
+this kernel is opt-in (CONSENSUS_PALLAS=1).
+
+On non-TPU backends (the CPU test mesh) the kernel runs in interpret
+mode — semantics-identical, so correctness tests run everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .field import FieldSpec
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.lru_cache(maxsize=None)
+def _mul_kernel(spec: FieldSpec, block_b: int):
+    """pallas_call for one (n, block_b) tile of a transposed-layout
+    batched field multiplication."""
+    from jax.experimental import pallas as pl
+
+    n, b_bits, mask = spec.n, spec.b, spec.mask
+    plan = spec._plan(list(spec._conv_bounds()))
+    fold_np = spec._fold_np  # (rows, n) int64 — static constants
+
+    n_rows = fold_np.shape[0]
+
+    def kernel(x_ref, y_ref, fold_ref, o_ref):
+        y = y_ref[:]                                   # (n, BT) int32
+        # Product convolution: 2n-1 positions on the sublane axis.
+        wide = None
+        for i in range(n):
+            xi = x_ref[i, :][None, :]                  # (1, BT)
+            term = jnp.pad(xi * y, ((i, n - 1 - i), (0, 0)))
+            wide = term if wide is None else wide + term
+        v = wide                                       # (2n-1, BT)
+        # Statically planned reduction — same steps, same bounds proof
+        # as FieldSpec._reduce, just on the transposed layout.
+        for step, arg in plan:
+            if step == "pad":
+                v = jnp.concatenate(
+                    [v, jnp.zeros((arg, v.shape[1]), jnp.int32)], axis=0)
+            elif step == "fold":
+                lo, hi = v[:n], v[n:]
+                acc = lo
+                for r in range(arg):
+                    frow = fold_ref[r, :][:, None]     # (n, 1)
+                    acc = acc + frow * hi[r, :][None, :]
+                v = acc
+            else:  # carry
+                if arg:
+                    v = jnp.concatenate(
+                        [v, jnp.zeros((1, v.shape[1]), jnp.int32)], axis=0)
+                c = v >> b_bits
+                v = (v & mask) + jnp.concatenate(
+                    [jnp.zeros((1, v.shape[1]), jnp.int32), c[:-1]], axis=0)
+        o_ref[:] = v
+
+    fold_in = jnp.asarray(fold_np, jnp.int32)
+
+    def call(xT, yT):
+        batch = xT.shape[1]
+        grid = (batch // block_b,)
+        spec_in = pl.BlockSpec((n, block_b), lambda i: (0, i))
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[spec_in, spec_in,
+                      pl.BlockSpec((n_rows, n), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((n, block_b), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((n, batch), jnp.int32),
+            interpret=_use_interpret(),
+        )(xT, yT, fold_in)
+
+    return call
+
+
+def mul_transposed(spec: FieldSpec, block_b: int = 256):
+    """Batched loose-limb field multiply in the transposed (n, B) layout
+    (B a multiple of block_b; block_b a multiple of 128 for full lane
+    use on TPU).  Loose in, loose out — bit-identical to spec.mul on the
+    transposed operands."""
+    return _mul_kernel(spec, block_b)
+
+
+def enabled() -> bool:
+    """Opt-in switch for wiring the pallas path into curve ops."""
+    return os.environ.get("CONSENSUS_PALLAS", "") == "1"
+
+
+class PallasField:
+    """FieldSpec facade whose mul/sq run through the Pallas kernel in
+    the standard (B, n) layout (transposes at the boundary; XLA folds
+    adjacent transposes when ops chain).  add/sub/neg and predicates
+    delegate to the wrapped spec — they are cheap single-reduce ops the
+    kernel wouldn't improve."""
+
+    def __init__(self, spec: FieldSpec, block_b: int = 256):
+        self._spec = spec
+        self._block_b = block_b
+        self._mul = mul_transposed(spec, block_b)
+
+    def __getattr__(self, name):
+        return getattr(self._spec, name)
+
+    def mul(self, x, y):
+        x, y = jnp.broadcast_arrays(x, y)
+        shape = x.shape
+        xT = jnp.moveaxis(x.reshape(-1, self._spec.n), 0, 1)
+        yT = jnp.moveaxis(y.reshape(-1, self._spec.n), 0, 1)
+        batch = xT.shape[1]
+        pad = (-batch) % self._block_b
+        if pad:
+            xT = jnp.pad(xT, ((0, 0), (0, pad)))
+            yT = jnp.pad(yT, ((0, 0), (0, pad)))
+        out = self._mul(xT, yT)
+        if pad:
+            out = out[:, :batch]
+        return jnp.moveaxis(out, 0, 1).reshape(shape)
+
+    def sq(self, x):
+        return self.mul(x, x)

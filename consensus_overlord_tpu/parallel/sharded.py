@@ -101,14 +101,16 @@ def sharded_verify_round(mesh: Mesh, axis: str = AXIS):
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis),) * 8,
-             out_specs=(P(), P(), P(), P(axis), P(), P(), P(), P()))
+             out_specs=(P(), P(), P(), P(axis), P(), P(), P()))
     def fn(x, sign, inf, ok, bits, px, py, pz):
         pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
-        valid = valid & ~inf
+        # Subgroup check stays PER-LANE — a batched residual check on
+        # the aggregate is unsound for the cofactor's small-torsion
+        # subgroups (see tpu_provider.verify_round_fn docstring).
+        valid = valid & ~inf & dev.g1_in_subgroup(pt)
         pt = dev.G1.select(valid, pt, dev.G1.infinity_like(x))
         agg = _combine_replicated(
             dev.G1, dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits)), axis)
-        sub_ok = dev.g1_agg_subgroup_check(agg)[0]
         ax, ay, ainf = dev.G1.to_affine(agg)
         vbits = bits * valid[..., None].astype(bits.dtype)
         gagg = _combine_replicated(
@@ -116,7 +118,7 @@ def sharded_verify_round(mesh: Mesh, axis: str = AXIS):
                 dev.G2.scalar_mul_bits(Point(px, py, pz), vbits)), axis)
         gx, gy, ginf = dev.G2.to_affine(gagg)
         return (dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid,
-                sub_ok, dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0])
+                dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0])
 
     return jax.jit(fn)
 

@@ -118,12 +118,30 @@ class TestProviderSurface:
 
 
 class TestSubgroupAttack:
+    def test_order3_component_rejected_deterministically(self, cpus, tpu):
+        """sig' = sig + T with T = (0, 2) the order-3 cofactor point: the
+        canonical attack against batched-by-linearity subgroup checks
+        (the residual r·(φ(T)−[λ]T) lives in Z/3 and cancels for 1/3 of
+        random weights — and relation-side r·T cancels with it, so a
+        linearity-batched checker ACCEPTS the rogue lane whenever the
+        subgroup residual misses).  The per-lane device check must
+        reject it on EVERY run — repeat to catch a probabilistic
+        accept."""
+        from consensus_overlord_tpu.crypto import bls12381 as oracle
+
+        sigs, hashes, voters = make_votes(cpus, b"torsion")
+        t = (0, 2)
+        assert oracle.g1_add(oracle.g1_add(t, t), t) is None  # order 3
+        rogue_pt = oracle.g1_add(oracle.g1_decompress(sigs[3]), t)
+        assert not oracle.g1_in_subgroup(rogue_pt)
+        sigs[3] = oracle.g1_compress(rogue_pt)
+        for _ in range(6):  # fresh random weights each attempt
+            got = tpu.verify_batch(sigs, hashes, voters)
+            assert got == [True, True, True, False, True, True]
+
     def test_non_subgroup_signature_lane_rejected(self, cpus, tpu):
-        """An on-curve G1 point OUTSIDE the r-torsion subgroup (cofactor
-        component) must fail, and must not poison the honest lanes.  This
-        drives the batched-by-linearity check (g1_agg_subgroup_check):
-        the aggregate residual fires, the provider falls back to exact
-        per-lane checks, and only the torsioned lane dies."""
+        """An on-curve G1 point OUTSIDE the r-torsion subgroup (generic
+        cofactor component) must fail without poisoning honest lanes."""
         from consensus_overlord_tpu.crypto import bls12381 as oracle
 
         x = 7
@@ -148,3 +166,23 @@ class TestSubgroupAttack:
         fire (no silent fallback-to-host on the hot path)."""
         sigs, hashes, voters = make_votes(cpus, msg=b"block-hash-sub")
         assert tpu.verify_batch(sigs, hashes, voters) == [True] * N
+
+
+class TestAsyncPipeline:
+    def test_async_matches_sync_and_pipelines(self, cpus, tpu):
+        """verify_batch_async: two in-flight batches resolve in order to
+        the same verdicts as the sync path (incl. a bad lane)."""
+        sigs1, hashes1, voters1 = make_votes(cpus, b"pipe-a")
+        sigs2, hashes2, voters2 = make_votes(cpus, b"pipe-b")
+        sigs2[1] = cpus[1].sign(sm3_hash(b"wrong"))
+        r1 = tpu.verify_batch_async(sigs1, hashes1, voters1)
+        r2 = tpu.verify_batch_async(sigs2, hashes2, voters2)
+        assert r1() == [True] * N
+        assert r2() == [True, False, True, True, True, True]
+
+    def test_async_multi_hash_falls_back_sync(self, cpus, tpu):
+        h1, h2 = sm3_hash(b"x1"), sm3_hash(b"x2")
+        sigs = [c.sign(h1) for c in cpus[:3]] + [c.sign(h2) for c in cpus[3:]]
+        hashes = [h1] * 3 + [h2] * (N - 3)
+        voters = [c.pub_key for c in cpus]
+        assert tpu.verify_batch_async(sigs, hashes, voters)() == [True] * N
