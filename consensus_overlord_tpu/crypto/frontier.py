@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -76,6 +77,13 @@ class BatchingVerifier:
         # asyncio holds only weak refs to tasks; in-flight batch tasks must
         # be pinned or GC can collect one mid-verify, hanging every waiter.
         self._inflight: set = set()
+        # One dedicated dispatch worker: device dispatches (which may
+        # block on a cold jit compile — minutes for a new batch shape —
+        # or on H2D transfers over a remote PJRT link) run OFF the event
+        # loop, and the single worker keeps dispatch order FIFO across
+        # flushes so pipelining stays deterministic.
+        self._dispatcher = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="frontier-dispatch")
         self.stats = FrontierStats()
 
     async def verify(self, signature: bytes, hash32: bytes,
@@ -122,19 +130,15 @@ class BatchingVerifier:
             verify_async = getattr(self._provider, "verify_batch_async",
                                    None)
             if verify_async is not None:
-                # Unseen pubkeys trigger a blocking device validation
-                # round-trip inside prep — run that warmup off-loop
-                # first (cold cache / post-reconfiguration only).
-                warm = getattr(self._provider, "warm_pubkeys", None)
-                if warm is not None:
-                    await asyncio.to_thread(warm, voters)
-                # Then dispatch on the loop thread (cheap host prep +
-                # async device enqueue), and block only for the readback
-                # in a worker thread: consecutive flushes overlap the
-                # ~200 ms dispatch→readback round-trip of a remote PJRT
-                # link with device compute (deterministic pipelining —
-                # thread-pool scheduling doesn't decide dispatch order).
-                resolver = verify_async(sigs, hashes, voters)
+                # Dispatch through the single ordered worker (off-loop:
+                # a cold compile or H2D transfer never stalls consensus
+                # timers), then block only for the readback in a second
+                # thread — consecutive flushes overlap the ~200 ms
+                # dispatch→readback round-trip of a remote PJRT link
+                # with device compute.
+                loop = asyncio.get_running_loop()
+                resolver = await loop.run_in_executor(
+                    self._dispatcher, verify_async, sigs, hashes, voters)
                 results = await asyncio.to_thread(resolver)
             else:
                 # Device dispatch blocks; keep the event loop live.

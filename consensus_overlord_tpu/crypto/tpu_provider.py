@@ -33,6 +33,7 @@ Signing keys stay host-side (SURVEY.md §7 hard part (e)).
 from __future__ import annotations
 
 import secrets
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -251,6 +252,11 @@ class TpuBlsCrypto:
         # voter bytes → row index into the stacked coord arrays, or -1
         # for known-bad keys.
         self._pk_index: Dict[bytes, int] = {}
+        # Guards the cache arrays + index: the frontier's dispatch worker
+        # and a service-thread reconfigure can race update_pubkeys, and an
+        # interleaved base-capture/concatenate would desynchronize the
+        # row offsets from the coordinate arrays.
+        self._pk_lock = threading.Lock()
         self._pk_px = np.zeros((0, 2, dev.FQ.n), np.int32)
         self._pk_py = np.zeros((0, 2, dev.FQ.n), np.int32)
         self._pk_pz = np.zeros((0, 2, dev.FQ.n), np.int32)
@@ -526,19 +532,16 @@ class TpuBlsCrypto:
             return
         self.update_pubkeys(missing)
 
-    def warm_pubkeys(self, voters: Sequence[bytes]) -> None:
-        """Validate-and-cache any unseen voter pubkeys now.  Callers on
-        an event loop (the frontier) run this in a worker thread before
-        dispatching, so the blocking device round-trip of a cold cache
-        never stalls the loop."""
-        self._ensure_pubkeys(voters)
-
     def update_pubkeys(self, voters: Sequence[bytes]) -> None:
         """Validate and cache a validator set's public keys — the analog of
         the reference's pubkey cache refresh on reconfigure/commit
         (src/consensus.rs:131-136, 622-629), where a bad key is surfaced
         per-key instead of panicking."""
         voters = [bytes(v) for v in voters]
+        with self._pk_lock:
+            self._update_pubkeys_locked(voters)
+
+    def _update_pubkeys_locked(self, voters: List[bytes]) -> None:
         voters = [v for v in voters if v not in self._pk_index]
         n = len(voters)
         if n == 0:

@@ -84,6 +84,10 @@ def _mul_kernel(spec: FieldSpec, block_b: int):
 
     def call(xT, yT):
         batch = xT.shape[1]
+        assert batch % block_b == 0, (
+            f"batch {batch} must be a multiple of block_b {block_b} "
+            "(a floored grid would silently skip trailing lanes); "
+            "PallasField.mul pads for you")
         grid = (batch // block_b,)
         spec_in = pl.BlockSpec((n, block_b), lambda i: (0, i))
         return pl.pallas_call(

@@ -95,8 +95,8 @@ def sharded_g1_verify_msm(mesh: Mesh, axis: str = AXIS):
 def sharded_verify_round(mesh: Mesh, axis: str = AXIS):
     """The fused single-dispatch verification step over the mesh (the
     sharded twin of tpu_provider.verify_round_fn): lanes shard, each
-    device validates + locally reduces its G1/G2 shards, partials combine
-    over ICI, and every device runs the same aggregate subgroup check —
+    device validates — including the PER-LANE subgroup check — and
+    locally reduces its G1/G2 shards, then partials combine over ICI —
     one SPMD program, strict replicated outputs, sharded validity."""
 
     @partial(shard_map, mesh=mesh,
