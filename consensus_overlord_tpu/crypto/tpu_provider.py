@@ -8,24 +8,30 @@ of a consensus round — N vote verifies at the leader, N pubkey
 aggregations per QC check — is batched across TPU lanes:
 
 * ``verify_batch``: random-linear-combination batch verification.  For
-  signatures S_i on a common message hash H by pubkeys P_i, draw random
+  signatures S_i on message hashes H_g by pubkeys P_i, draw random
   64-bit r_i (blst's batch width; acceptance of a forged batch ≤ 2^-63
   per attempt, and the per-lane fallback then localizes) and check one
   relation
-      e(Σ r_i·S_i, −g2) · e(H, Σ r_i·P_i) == 1
-  The two multi-scalar-multiplications (the O(N) part) run on device as
-  uniform double-and-add scans + a log₂(N) tree reduction; the two
-  pairings (O(1)) run on the host oracle.  Distinct messages group into
-  one extra pairing per distinct hash.  A failed batch falls back to
+      e(Σ r_i·S_i, −g2) · Π_g e(H_g, Σ_{i∈g} r_i·P_i) == 1
+  The multi-scalar-multiplications (the O(N) part) run on device as
+  digit-plane MSMs (ops/curve.py msm_bits — the SIMD shape of
+  Pippenger's bucket method); the pairings (O(1 + #distinct hashes))
+  run on the host native backend.  A failed batch falls back to
   per-signature verification, so results are exact, not probabilistic.
 
 * ``aggregate_signatures`` / ``verify_aggregated_signature``: the QC
   hot path (reference src/consensus.rs:418-462) — device tree-sum over
   decompressed points for large N, host oracle below a crossover size.
+  Both have ``*_async`` forms that dispatch device work immediately and
+  return a blocking ``resolve()`` — the engine's event loop awaits the
+  resolution off-thread (crypto/frontier.py) instead of stalling
+  consensus timers on a device round-trip.
 
-Host↔device traffic is one transfer of packed int32 limb arrays each way
-per batch — sized for a high-latency PJRT link where each dispatch is
-expensive (SURVEY.md §7 hard part (c)).
+Host↔device traffic per batch is minimized for a high-latency PJRT link
+(SURVEY.md §7 hard part (c)): the validated pubkey cache lives ON DEVICE
+(uploaded once per reconfigure, gathered by row index inside the
+kernel), and RLC weights ship packed as (B, 8) uint8 and unpack on
+device — a batch uploads ~210 B/lane instead of ~1.2 KB/lane.
 
 Signing keys stay host-side (SURVEY.md §7 hard part (e)).
 """
@@ -57,8 +63,16 @@ _PAD_SIZES = (8, 32, 128, 512, 1024, 2048, 8192)
 # Random-linear-combination weight width.  64-bit weights (the width
 # native blst uses for its batch verification) bound a forged batch's
 # acceptance at 2^-64 per attempt; the per-lane fallback then localizes,
-# so results stay exact.  Halves both MSM scan lengths vs 128-bit.
+# so results stay exact.
 _SCALAR_BITS = 64
+# Pubkey-cache device capacity ladder (rows, kept replicated on every
+# device): jit kernels specialize on the cache shape, so it grows in
+# big steps and reuploads only on ladder crossings.
+_PK_CAPS = (256, 1024, 4096, 16384)
+# Fused multi-hash kernel group-count ladder: mixed vote+proposal+choke
+# frontier batches carry ≤3 distinct hashes; k pads to one of these and
+# larger hash counts split into pipelined single-hash sub-batches.
+_GROUP_SIZES = (2, 4)
 
 
 def _pad_to(n: int) -> int:
@@ -68,68 +82,65 @@ def _pad_to(n: int) -> int:
     return -(-n // _PAD_SIZES[-1]) * _PAD_SIZES[-1]
 
 
+def _pk_capacity(n: int) -> int:
+    for s in _PK_CAPS:
+        if n <= s:
+            return s
+    return -(-n // _PK_CAPS[-1]) * _PK_CAPS[-1]
+
+
 # ---------------------------------------------------------------------------
 # Device kernels (module-level so jax.jit caches by shape).
 # ---------------------------------------------------------------------------
 
-def g1_validate_msm_fn(x, sign, inf, ok, bits):
-    """Decompress+validate a batch of G1 signatures and reduce Σ r_i·S_i.
-    Returns (strict affine x, strict affine y, agg-is-infinity, per-lane
-    valid).  Un-jitted core (per-lane subgroup-check variant, used by the
-    multi-hash path; the single-hash fast path is verify_round_fn)."""
-    pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
-    valid = valid & ~inf
-    valid = valid & dev.g1_in_subgroup(pt)
-    pt = dev.G1.select(valid, pt, dev.G1.infinity_like(x))
-    agg = dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits))
-    ax, ay, ainf = dev.G1.to_affine(agg)
-    return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid
-
-
-_g1_validate_msm = jax.jit(g1_validate_msm_fn)
-
-
-def verify_round_fn(x, sign, inf, ok, bits, px, py, pz):
+def verify_round_fn(x, sign, inf, ok, wpacked, rows, pkx, pky, pkz):
     """The fused single-dispatch consensus-round verification step — the
-    flagship forward step.  One jit covers what used to be two kernel
-    dispatches plus four canonicalization round-trips (each round-trip
-    costs ~100 ms over a remote PJRT link, which dominated the measured
-    batch time):
-
-      G1: decompress + validate + per-lane fast subgroup check of the
-        signatures, then Σ r_i·S_i
-      G2: Σ r_i·P_i over the gathered pubkey rows, weights masked by the
-        device-computed validity so both sides of the pairing relation
-        see the same lane set
-
-    The subgroup check must stay PER-LANE.  A batched-by-linearity form
-    (check φ(A) == [λ]A on the aggregate only) is unsound: the G1
-    cofactor is 3 · 11² · 10177² · …, so the per-lane residuals live in
-    a group with small subgroups — a signature carrying the order-3
-    point (0, 2) cancels out of the aggregate whenever its random weight
-    is ≡ 0 (mod 3) (probability 1/3), and two colluding lanes cancel
-    deterministically for ANY weight distribution.  A probabilistic
-    accept of a non-subgroup signature that the host oracle rejects
-    would split honest validators — consensus requires deterministic
-    accept sets.  (tests/test_tpu_provider.py::TestSubgroupAttack pins
-    both the random-cofactor and the order-3-component attacks.)
-
-    Returns strict (numpy-decodable) affine coords for both aggregates
-    plus the per-lane validity.
-    """
-    pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
-    valid = valid & ~inf & dev.g1_in_subgroup(pt)
-    pt = dev.G1.select(valid, pt, dev.G1.infinity_like(x))
-    agg = dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits))
+    flagship forward step.  One jit covers: weight unpack, G1 decompress
+    + validate + per-lane fast subgroup check, the G1 digit-plane MSM
+    Σ r_i·S_i, the pubkey-cache gather, and the G2 MSM Σ r_i·P_i with
+    weights masked by the device-computed validity so both sides of the
+    pairing relation see the same lane set.  Returns strict
+    (numpy-decodable) affine coords for both aggregates plus the
+    per-lane validity — ONE device_get on the caller side (each extra
+    D2H read costs ~150 ms over a remote PJRT link)."""
+    bits = dev.unpack_weight_bits(wpacked)
+    pt, valid = dev.g1_validate_batch(x, sign, inf, ok)
+    agg = dev.G1.msm_bits(pt, bits)
     ax, ay, ainf = dev.G1.to_affine(agg)
     vbits = bits * valid[..., None].astype(bits.dtype)
-    gagg = dev.G2.tree_sum(dev.G2.scalar_mul_bits(Point(px, py, pz), vbits))
+    gagg = dev.G2.msm_bits(dev.gather_rows(rows, pkx, pky, pkz), vbits)
     gx, gy, ginf = dev.G2.to_affine(gagg)
     return (dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid,
             dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0])
 
 
 _verify_round = jax.jit(verify_round_fn)
+
+
+def verify_round_multi_fn(x, sign, inf, ok, wpacked, rows, gmask,
+                          pkx, pky, pkz):
+    """k-hash fused verification round: one G1 MSM over all lanes plus
+    one G2 MSM per hash group (weights masked by validity AND the
+    host-computed group membership `gmask` (k, B)).  Mixed
+    vote+proposal+choke frontier batches (≤4 distinct hashes) stay a
+    single dispatch instead of degrading to serial per-group kernels.
+    Returns G1 aggregate + validity + per-group G2 aggregates."""
+    bits = dev.unpack_weight_bits(wpacked)
+    pt, valid = dev.g1_validate_batch(x, sign, inf, ok)
+    agg = dev.G1.msm_bits(pt, bits)
+    ax, ay, ainf = dev.G1.to_affine(agg)
+    pk = dev.gather_rows(rows, pkx, pky, pkz)
+    outs = [dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid]
+    for g in range(gmask.shape[0]):
+        m = valid & gmask[g]
+        vbits = bits * m[..., None].astype(bits.dtype)
+        gagg = dev.G2.msm_bits(pk, vbits)
+        gx, gy, ginf = dev.G2.to_affine(gagg)
+        outs += [dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0]]
+    return tuple(outs)
+
+
+_verify_round_multi = jax.jit(verify_round_multi_fn)
 
 
 @jax.jit
@@ -140,14 +151,6 @@ def _g2_validate(x, sign, inf, ok):
     valid = valid & ~inf
     valid = valid & dev.g2_in_subgroup(pt)
     return pt.x, pt.y, pt.z, valid
-
-
-@jax.jit
-def _g2_msm(px, py, pz, bits):
-    """Σ r_i·P_i over pre-validated G2 points; strict affine result."""
-    agg = dev.G2.tree_sum(dev.G2.scalar_mul_bits(Point(px, py, pz), bits))
-    ax, ay, ainf = dev.G2.to_affine(agg)
-    return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0]
 
 
 @jax.jit
@@ -163,10 +166,12 @@ def _g1_validate_sum(x, sign, inf, ok):
 
 
 @jax.jit
-def _g2_sum(px, py, pz):
-    """Σ P_i over pre-validated G2 points (QC pubkey aggregation,
-    reference src/consensus.rs:365-383)."""
-    agg = dev.G2.tree_sum(Point(px, py, pz))
+def _g2_sum_rows(rows, mask, pkx, pky, pkz):
+    """Σ P_i over cached pubkey rows (QC pubkey aggregation, reference
+    src/consensus.rs:365-383) — padding lanes masked to the identity."""
+    pk = dev.gather_rows(rows, pkx, pky, pkz)
+    pk = dev.G2.select(mask, pk, dev.G2.infinity_like(pk.x))
+    agg = dev.G2.tree_sum(pk)
     ax, ay, ainf = dev.G2.to_affine(agg)
     return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0]
 
@@ -174,39 +179,37 @@ def _g2_sum(px, py, pz):
 class _SingleChipKernels:
     """The module-level jits above, as the default kernel set."""
 
-    g1_validate_msm = staticmethod(lambda *a: _g1_validate_msm(*a))
     g2_validate = staticmethod(lambda *a: _g2_validate(*a))
-    g2_msm = staticmethod(lambda *a: _g2_msm(*a))
     g1_validate_sum = staticmethod(lambda *a: _g1_validate_sum(*a))
-    g2_sum = staticmethod(lambda *a: _g2_sum(*a))
+    g2_sum_rows = staticmethod(lambda *a: _g2_sum_rows(*a))
     verify_round = staticmethod(lambda *a: _verify_round(*a))
+    verify_round_multi = staticmethod(lambda *a: _verify_round_multi(*a))
     lanes = 1
 
 
 class _MeshKernels:
     """The same kernel surface jitted over a device mesh via shard_map
-    (parallel/sharded.py): signature/pubkey lanes shard across devices,
-    partial group sums combine over the mesh axis (ICI).  Batch padding
-    must be a multiple of the mesh size; the provider's pad ladder is
-    adjusted through `lanes`."""
+    (parallel/sharded.py): signature lanes and pubkey-row indices shard
+    across devices, the pubkey cache is replicated, and partial group
+    sums combine over the mesh axis (ICI).  Batch padding must be a
+    multiple of the mesh size; the provider's pad ladder is adjusted
+    through `lanes`."""
 
     def __init__(self, mesh):
         from ..parallel import (
             sharded_g1_validate_sum,
-            sharded_g1_verify_msm,
-            sharded_g2_msm,
-            sharded_g2_sum,
+            sharded_g2_sum_rows,
             sharded_g2_validate,
             sharded_verify_round,
+            sharded_verify_round_multi,
         )
         self.mesh = mesh
         self.lanes = mesh.devices.size
-        self.g1_validate_msm = sharded_g1_verify_msm(mesh)
         self.g2_validate = sharded_g2_validate(mesh)
-        self.g2_msm = sharded_g2_msm(mesh)
         self.g1_validate_sum = sharded_g1_validate_sum(mesh)
-        self.g2_sum = sharded_g2_sum(mesh)
+        self.g2_sum_rows = sharded_g2_sum_rows(mesh)
         self.verify_round = sharded_verify_round(mesh)
+        self.verify_round_multi = sharded_verify_round_multi(mesh)
 
 
 def _affine_to_oracle_g1(ax, ay, ainf) -> Optional[Tuple[int, int]]:
@@ -261,6 +264,10 @@ class TpuBlsCrypto:
         self._pk_py = np.zeros((0, 2, dev.FQ.n), np.int32)
         self._pk_pz = np.zeros((0, 2, dev.FQ.n), np.int32)
         self._pk_aff: List[tuple] = []
+        # Device-resident copy of the cache, padded to a capacity ladder
+        # (stable kernel shapes).  Uploaded once per reconfigure — per
+        # batch only the (B,) row indices travel over the link.
+        self._pk_dev: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
 
     def _pad_to(self, n: int) -> int:
         """Pad ladder size, kept a multiple of the mesh lane count so
@@ -287,12 +294,20 @@ class TpuBlsCrypto:
 
     def aggregate_signatures(self, signatures: Sequence[bytes],
                              voters: Sequence[bytes]) -> bytes:
+        return self.aggregate_signatures_async(signatures, voters)()
+
+    def aggregate_signatures_async(self, signatures: Sequence[bytes],
+                                   voters: Sequence[bytes]):
+        """Dispatch the QC signature aggregation now; returns resolve()
+        → compressed aggregate bytes (raises CryptoError on a bad lane).
+        The engine's leader path awaits this off the event loop
+        (crypto/frontier.py BatchingVerifier.aggregate)."""
         if len(signatures) != len(voters):
             raise CryptoError(
                 f"signatures x voters length mismatch "
                 f"{len(signatures)} x {len(voters)}")
         if len(signatures) < self._threshold:
-            return self._cpu.aggregate_signatures(signatures, voters)
+            return lambda: self._cpu.aggregate_signatures(signatures, voters)
         n = len(signatures)
         size = self._pad_to(n)
         parsed = dev.parse_g1_compressed(list(signatures))
@@ -304,39 +319,63 @@ class TpuBlsCrypto:
         inf[:n] = parsed.infinity
         ok = np.zeros(size, bool)
         ok[:n] = parsed.wellformed
-        # ONE device_get for the whole output tuple: each separate
-        # np.asarray()/bool() on a device array is its own blocking D2H
-        # round-trip (~150 ms on a remote PJRT link; five of them cost
-        # more than the kernel).
-        ax, ay, ainf, valid = jax.device_get(self._kernels.g1_validate_sum(
+        out = self._kernels.g1_validate_sum(
             jnp.asarray(x), jnp.asarray(sign_f), jnp.asarray(inf),
-            jnp.asarray(ok)))
-        if not bool(valid[:n].all()):
-            raise CryptoError("invalid signature in aggregation batch")
-        return oracle.g1_compress(_affine_to_oracle_g1(ax, ay, ainf))
+            jnp.asarray(ok))
+
+        def resolve() -> bytes:
+            # ONE device_get for the whole output tuple: each separate
+            # np.asarray()/bool() on a device array is its own blocking
+            # D2H round-trip (~150 ms on a remote PJRT link).
+            ax, ay, ainf, valid = jax.device_get(out)
+            if not bool(valid[:n].all()):
+                raise CryptoError("invalid signature in aggregation batch")
+            return oracle.g1_compress(_affine_to_oracle_g1(ax, ay, ainf))
+
+        return resolve
 
     def verify_aggregated_signature(self, agg_sig: bytes, hash32: bytes,
                                     voters: Sequence[bytes]) -> bool:
+        return self.verify_aggregated_async(agg_sig, hash32, voters)()
+
+    def verify_aggregated_async(self, agg_sig: bytes, hash32: bytes,
+                                voters: Sequence[bytes]):
+        """Dispatch the QC pubkey aggregation now (device gather from the
+        resident cache); returns resolve() → bool finishing host-side
+        (signature decompress + 2 pairings)."""
         if len(voters) < self._threshold:
-            return self._cpu.verify_aggregated_signature(
+            return lambda: self._cpu.verify_aggregated_signature(
                 agg_sig, hash32, voters)
-        rows = self._pubkey_rows(voters)
-        if rows is None:
-            return False
-        px, py, pz = rows
-        agg_pk = _affine_to_oracle_g2(*jax.device_get(self._kernels.g2_sum(
-            jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz))))
-        if agg_pk is None:
-            return False
-        try:
-            sig_pt = oracle.g1_decompress(agg_sig)
-        except ValueError:
-            return False
-        if sig_pt is None or not oracle.g1_in_subgroup(sig_pt):
-            return False
-        h = oracle.hash_to_g1(hash32, self._common_ref)
-        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
-        return oracle.multi_pairing_is_one([(sig_pt, neg_g2), (h, agg_pk)])
+        idx = self._pk_rows_of(voters)
+        if (idx < 0).any():
+            # An aggregated QC over an invalid key can never verify.
+            return lambda: False
+        n = len(voters)
+        size = self._pad_to(n)
+        rows = np.zeros(size, np.int64)
+        rows[:n] = idx
+        mask = np.zeros(size, bool)
+        mask[:n] = True
+        pkx, pky, pkz = self._pk_device()
+        out = self._kernels.g2_sum_rows(
+            jnp.asarray(rows), jnp.asarray(mask), pkx, pky, pkz)
+
+        def resolve() -> bool:
+            agg_pk = _affine_to_oracle_g2(*jax.device_get(out))
+            if agg_pk is None:
+                return False
+            try:
+                sig_pt = oracle.g1_decompress(agg_sig)
+            except ValueError:
+                return False
+            if sig_pt is None or not oracle.g1_in_subgroup(sig_pt):
+                return False
+            h = oracle.hash_to_g1(hash32, self._common_ref)
+            neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+            return oracle.multi_pairing_is_one([(sig_pt, neg_g2),
+                                                (h, agg_pk)])
+
+        return resolve
 
     # -- batched verification ------------------------------------------------
 
@@ -347,66 +386,7 @@ class TpuBlsCrypto:
         The common case — many votes on one hash — costs two device MSMs
         plus 1 + #distinct-hashes host pairings; a failed batch relation
         falls back to per-signature checks to localize the bad lanes."""
-        n = len(signatures)
-        assert len(hashes) == n and len(voters) == n
-        if n == 0:
-            return []
-        if n < self._threshold:
-            return [self._cpu.verify_signature(s, h, v)
-                    for s, h, v in zip(signatures, hashes, voters)]
-
-        (size, sx, ssign, sinf, sok, bits,
-         pk_idx, pk_ok) = self._host_prep(signatures, voters, n)
-
-        # Fast path — all lanes vote on ONE hash (the consensus common
-        # case): a single fused dispatch computes both MSMs and the
-        # per-lane validity (incl. subgroup checks).
-        if len(set(map(bytes, hashes))) == 1:
-            return self._dispatch_single_hash(
-                signatures, bytes(hashes[0]), voters, n, size,
-                sx, ssign, sinf, sok, bits, pk_idx, pk_ok)()
-
-        ax, ay, ainf, valid = jax.device_get(self._kernels.g1_validate_msm(
-            jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-            jnp.asarray(sok), jnp.asarray(bits)))
-        valid = valid[:n] & pk_ok
-        agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
-
-        # Group lanes by message hash: one G2 MSM + one pairing per group.
-        groups: Dict[bytes, List[int]] = {}
-        for i, h in enumerate(hashes):
-            if valid[i]:
-                groups.setdefault(bytes(h), []).append(i)
-        if not groups:
-            return [False] * n
-
-        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
-        pairs = [(agg_sig, neg_g2)]
-        for h, idxs in groups.items():
-            gsize = self._pad_to(len(idxs))
-            rows = np.zeros(gsize, np.int64)
-            rows[:len(idxs)] = pk_idx[idxs]
-            px = self._pk_px[rows]
-            py = self._pk_py[rows]
-            pz = self._pk_pz[rows]
-            px[len(idxs):] = 0
-            py[len(idxs):] = 0
-            pz[len(idxs):] = 0
-            gbits = np.zeros((gsize, _SCALAR_BITS), np.int32)
-            gbits[:len(idxs)] = bits[idxs]
-            agg_pk = _affine_to_oracle_g2(*jax.device_get(
-                self._kernels.g2_msm(
-                    jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz),
-                    jnp.asarray(gbits))))
-            h_pt = oracle.hash_to_g1(h, self._common_ref)
-            pairs.append((h_pt, agg_pk))
-
-        if oracle.multi_pairing_is_one(pairs):
-            return list(valid)
-        # Batch relation failed: localize with exact per-lane checks.
-        return [bool(valid[i]) and self._verify_one_cached(
-                    signatures[i], hashes[i], voters[i])
-                for i in range(n)]
+        return self.verify_batch_async(signatures, hashes, voters)()
 
     def verify_batch_async(self, signatures: Sequence[bytes],
                            hashes: Sequence[bytes],
@@ -420,30 +400,59 @@ class TpuBlsCrypto:
         batch k overlaps that latency with device compute (measured 1.5x
         throughput at depth 4–8).  The engine's vote stream is exactly
         such a pipeline: the frontier can flush the next coalesced batch
-        while the previous one's pairing finishes."""
+        while the previous one's pairing finishes.
+
+        Every ≥threshold batch dispatches immediately — single-hash and
+        ≤4-hash batches as ONE fused kernel, larger hash counts as
+        per-hash single-hash sub-batches issued back-to-back (still
+        pipelined; nothing silently degrades to a blocking path)."""
         n = len(signatures)
         assert len(hashes) == n and len(voters) == n
-        single = n > 0 and len(set(map(bytes, hashes))) == 1
-        if n == 0 or n < self._threshold or not single:
-            # Below-threshold and multi-hash batches take the sync path,
-            # LAZILY: the frontier calls resolve() off the event loop, so
-            # the blocking device work must happen there, not here.
-            return lambda: self.verify_batch(signatures, hashes, voters)
-        prep = self._host_prep(signatures, voters, n)
-        return self._dispatch_single_hash(
-            signatures, bytes(hashes[0]), voters, n, *prep[:6],
-            prep[6], prep[7])
+        if n == 0:
+            return lambda: []
+        if n < self._threshold:
+            # Host-oracle path — no device dispatch to pipeline; resolve
+            # lazily so the frontier's off-loop worker pays the CPU cost.
+            return lambda: [self._cpu.verify_signature(s, h, v)
+                            for s, h, v in zip(signatures, hashes, voters)]
+
+        groups: Dict[bytes, List[int]] = {}
+        for i, h in enumerate(hashes):
+            groups.setdefault(bytes(h), []).append(i)
+
+        if len(groups) == 1:
+            prep = self._host_prep(signatures, voters, n)
+            return self._dispatch_single_hash(
+                signatures, bytes(hashes[0]), voters, n, *prep)
+        if len(groups) <= _GROUP_SIZES[-1]:
+            return self._dispatch_multi_hash(signatures, voters, n, groups)
+        # Many distinct hashes (beyond the fused-kernel ladder): verify
+        # each hash group as its own single-hash sub-batch, dispatched
+        # back-to-back now and resolved together.
+        resolvers = []
+        for h, idxs in groups.items():
+            resolvers.append((idxs, self.verify_batch_async(
+                [signatures[i] for i in idxs], [h] * len(idxs),
+                [voters[i] for i in idxs])))
+
+        def resolve_split() -> List[bool]:
+            results = [False] * n
+            for idxs, r in resolvers:
+                for i, ok in zip(idxs, r()):
+                    results[i] = ok
+            return results
+
+        return resolve_split
 
     # -- internals -----------------------------------------------------------
 
     def _host_prep(self, signatures, voters, n):
-        """Shared host-side prep for BOTH the sync and async batch paths
-        (one copy: the two paths must verify under identical parsing,
-        padding, and RLC weight distributions or they drift apart):
-        parse + pad signature fields, validate/cache pubkeys, draw
-        weights.  Returns (size, sx, ssign, sinf, sok, bits, pk_idx,
-        pk_ok)."""
-        # Pubkeys: validate (cached) and gather device rows.
+        """Shared host-side prep for every batch path (one copy: all
+        paths must verify under identical parsing, padding, and RLC
+        weight distributions or they drift apart): parse + pad signature
+        fields, validate/cache pubkeys, draw packed weights.  Returns
+        (size, sx, ssign, sinf, sok, wpacked, rows, pk_idx, pk_ok)."""
+        # Pubkeys: validate (cached) and resolve device cache rows.
         pk_idx = self._pk_rows_of(voters)
         pk_ok = pk_idx >= 0
         size = self._pad_to(n)
@@ -457,29 +466,27 @@ class TpuBlsCrypto:
         sok = np.zeros(size, bool)
         # lanes with bad pubkeys are disabled entirely
         sok[:n] = parsed.wellformed & pk_ok
-        # Random _SCALAR_BITS-wide weights (top bit forced: nonzero);
-        # padding lanes get weight 0.  One vectorized unpackbits, not a
-        # Python double loop (which costs ~100 ms per 1024-lane batch).
-        packed = np.frombuffer(
+        # Random 64-bit weights, packed big-endian (top bit forced:
+        # nonzero); padding lanes get weight 0.  Unpacked on device —
+        # 8 B/lane over the link instead of 256.
+        wpacked = np.zeros((size, _SCALAR_BITS // 8), np.uint8)
+        wpacked[:n] = np.frombuffer(
             secrets.token_bytes(n * _SCALAR_BITS // 8),
-            np.uint8).reshape(n, _SCALAR_BITS // 8).copy()
-        packed[:, 0] |= 0x80  # force the top bit: scalars nonzero
-        bits = np.zeros((size, _SCALAR_BITS), np.int32)
-        bits[:n] = np.unpackbits(packed, axis=1)
-        return size, sx, ssign, sinf, sok, bits, pk_idx, pk_ok
+            np.uint8).reshape(n, _SCALAR_BITS // 8)
+        wpacked[:n, 0] |= 0x80  # force the top bit: scalars nonzero
+        rows = np.zeros(size, np.int64)
+        rows[:n] = np.maximum(pk_idx, 0)  # bad-key lanes: sok=False
+        return size, sx, ssign, sinf, sok, wpacked, rows, pk_idx, pk_ok
 
     def _dispatch_single_hash(self, signatures, h, voters, n, size,
-                              sx, ssign, sinf, sok, bits, pk_idx, pk_ok):
+                              sx, ssign, sinf, sok, wpacked, rows,
+                              pk_idx, pk_ok):
         """Dispatch the fused kernel; return resolve() → List[bool]."""
-        pad_rows = np.zeros(size, np.int64)
-        pad_rows[:n] = np.maximum(pk_idx, 0)  # bad-key lanes: sok=False
-        px = self._pk_px[pad_rows]
-        py = self._pk_py[pad_rows]
-        pz = self._pk_pz[pad_rows]
+        pkx, pky, pkz = self._pk_device()
         out = self._kernels.verify_round(
             jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-            jnp.asarray(sok), jnp.asarray(bits), jnp.asarray(px),
-            jnp.asarray(py), jnp.asarray(pz))
+            jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
+            pkx, pky, pkz)
 
         def resolve() -> List[bool]:
             # ONE device_get: separate per-output reads would each pay a
@@ -503,6 +510,58 @@ class TpuBlsCrypto:
                     for i in range(n)]
 
         return resolve
+
+    def _dispatch_multi_hash(self, signatures, voters, n,
+                             groups: Dict[bytes, List[int]]):
+        """Dispatch the k-group fused kernel (k padded up the group-count
+        ladder with empty masks); return resolve() → List[bool]."""
+        (size, sx, ssign, sinf, sok, wpacked, rows,
+         pk_idx, pk_ok) = self._host_prep(signatures, voters, n)
+        k = next(s for s in _GROUP_SIZES if len(groups) <= s)
+        gmask = np.zeros((k, size), bool)
+        ghashes = list(groups)
+        for g, h in enumerate(ghashes):
+            gmask[g, groups[h]] = True
+        pkx, pky, pkz = self._pk_device()
+        out = self._kernels.verify_round_multi(
+            jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+            jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
+            jnp.asarray(gmask), pkx, pky, pkz)
+        lane_hashes = self._lane_hashes(groups, n)
+
+        def resolve() -> List[bool]:
+            flat = jax.device_get(out)
+            ax, ay, ainf, valid = flat[:4]
+            v = valid[:n] & pk_ok
+            if not v.any():
+                return [False] * n
+            agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
+            neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+            pairs = [(agg_sig, neg_g2)]
+            for g, h in enumerate(ghashes):
+                gx, gy, ginf = flat[4 + 3 * g: 7 + 3 * g]
+                agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
+                if agg_pk is None:
+                    # No valid lane voted on this hash — nothing to pair.
+                    continue
+                pairs.append((oracle.hash_to_g1(h, self._common_ref),
+                              agg_pk))
+            if oracle.multi_pairing_is_one(pairs):
+                return list(v)
+            # Batch relation failed: exact per-lane localization.
+            return [bool(v[i]) and self._verify_one_cached(
+                        signatures[i], lane_hashes[i], voters[i])
+                    for i in range(n)]
+
+        return resolve
+
+    @staticmethod
+    def _lane_hashes(groups: Dict[bytes, List[int]], n: int) -> List[bytes]:
+        lane = [b""] * n
+        for h, idxs in groups.items():
+            for i in idxs:
+                lane[i] = h
+        return lane
 
     def _verify_one_cached(self, sig: bytes, hash32: bytes,
                            voter: bytes) -> bool:
@@ -568,31 +627,29 @@ class TpuBlsCrypto:
         self._pk_aff.extend(aff)
         for i, v in enumerate(voters):
             self._pk_index[v] = base + i if valid[i] else -1
+        self._pk_dev = None  # device copy is stale; re-upload lazily
 
-    def _pk_rows_of(self, voters: Sequence[bytes]) -> Optional[np.ndarray]:
-        """Row indices into the stacked pubkey arrays; None rows = -1."""
+    def _pk_device(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """The device-resident pubkey cache, padded to the capacity
+        ladder (stable kernel shapes).  Re-uploaded only after
+        update_pubkeys grew the host arrays — a per-reconfigure cost;
+        per batch only the (B,) row indices travel over the link."""
+        with self._pk_lock:
+            if self._pk_dev is None:
+                rows = max(self._pk_px.shape[0], 1)
+                cap = _pk_capacity(rows)
+                px = np.zeros((cap, 2, dev.FQ.n), np.int32)
+                py = np.zeros((cap, 2, dev.FQ.n), np.int32)
+                pz = np.zeros((cap, 2, dev.FQ.n), np.int32)
+                px[:self._pk_px.shape[0]] = self._pk_px
+                py[:self._pk_py.shape[0]] = self._pk_py
+                pz[:self._pk_pz.shape[0]] = self._pk_pz
+                self._pk_dev = (jnp.asarray(px), jnp.asarray(py),
+                                jnp.asarray(pz))
+            return self._pk_dev
+
+    def _pk_rows_of(self, voters: Sequence[bytes]) -> np.ndarray:
+        """Row indices into the stacked pubkey arrays; bad keys = -1."""
         self._ensure_pubkeys(voters)
         return np.fromiter((self._pk_index[bytes(v)] for v in voters),
                            np.int64, len(voters))
-
-    def _pubkey_rows(self, voters: Sequence[bytes]):
-        """Gathered, padded device rows for a voter list; None if any
-        voter's key is invalid (an aggregated QC over a bad key can never
-        verify)."""
-        idx = self._pk_rows_of(voters)
-        if (idx < 0).any():
-            return None
-        n = len(voters)
-        size = self._pad_to(n)
-        pad_idx = np.zeros(size, np.int64)
-        pad_idx[:n] = idx
-        px = self._pk_px[pad_idx]
-        py = self._pk_py[pad_idx]
-        pz = self._pk_pz[pad_idx]
-        # padding lanes: projective identity (0:1:0)
-        one2 = np.zeros((2, dev.FQ.n), np.int32)
-        one2[0] = dev.FQ.from_int(1)
-        px[n:] = 0
-        py[n:] = one2
-        pz[n:] = 0
-        return px, py, pz

@@ -224,6 +224,41 @@ def g2_in_subgroup(p: Point) -> Array:
     return G2.eq(g2_endomorphism(p), zq) & G2.on_curve(p)
 
 
+# ---------------------------------------------------------------------------
+# Composite device steps shared by the single-chip jits
+# (crypto/tpu_provider.py) and their shard_map twins (parallel/sharded.py)
+# — one copy so the two paths can never drift.
+# ---------------------------------------------------------------------------
+
+def unpack_weight_bits(wpacked: Array) -> Array:
+    """(B, 8) uint8 → (B, 64) int32 MSB-first bit array, on device.  The
+    RLC weights ship packed (8 bytes/lane instead of a 256-byte int32
+    bit array) and fan out here — H2D bytes are the scarce resource on a
+    remote PJRT link."""
+    w = wpacked.astype(jnp.int32)
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.int32)
+    bits = (w[..., None] >> shifts) & 1
+    return bits.reshape(w.shape[:-1] + (w.shape[-1] * 8,))
+
+
+def gather_rows(rows: Array, px: Array, py: Array, pz: Array) -> Point:
+    """Gather pubkey rows from the device-resident cache (rows are
+    pre-validated host-side; masked lanes point at row 0)."""
+    return Point(jnp.take(px, rows, axis=0), jnp.take(py, rows, axis=0),
+                 jnp.take(pz, rows, axis=0))
+
+
+def g1_validate_batch(x: Array, sign: Array, infinity: Array,
+                      wellformed: Array) -> Tuple[Point, Array]:
+    """Decompress + validate + PER-LANE subgroup-check a G1 signature
+    batch; invalid lanes become the identity.  The subgroup check must
+    stay per-lane (see the NOTE below — a batched residual check is
+    unsound for the cofactor's small-torsion subgroups)."""
+    pt, valid = g1_decompress_device(x, sign, infinity, wellformed)
+    valid = valid & ~infinity & g1_in_subgroup(pt)
+    return G1.select(valid, pt, G1.infinity_like(x)), valid
+
+
 # NOTE: there is deliberately NO batched-by-linearity subgroup check
 # (φ(ΣrᵢSᵢ) == [λ]ΣrᵢSᵢ) here.  It looks sound — φ is linear and the
 # per-lane residuals φ(Sᵢ)−[λ]Sᵢ vanish iff Sᵢ ∈ G1 — but the residuals

@@ -16,7 +16,7 @@ scalars) of src/consensus.rs:418-444 done in log₂(N) batched steps.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Sequence, Tuple
+from typing import Callable, List, NamedTuple, Sequence, Tuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -30,6 +30,22 @@ class Point(NamedTuple):
     x: Array
     y: Array
     z: Array
+
+
+def _signed_base16_digits(k: int) -> List[int]:
+    """MSB-first signed base-16 digits of k ≥ 1, each in [−8, 8] (so a
+    0..8 point table plus free negation covers every digit)."""
+    assert k >= 1
+    digs: List[int] = []
+    v = k
+    while v:
+        d = v & 15
+        if d > 8:
+            d -= 16
+        v = (v - d) >> 4
+        digs.append(d)
+    digs.reverse()
+    return digs
 
 
 class CurveOps:
@@ -86,7 +102,25 @@ class CurveOps:
         return Point(x3, y3, z3)
 
     def dbl(self, p: Point) -> Point:
-        return self.add(p, p)
+        """Dedicated doubling (Renes–Costello–Batina 2016, Algorithm 9,
+        a = 0): 8 field muls vs the complete add's 12 — exception-free
+        for every input including the identity (0:1:0 maps to itself)
+        and 2-torsion (y = 0 maps to the identity).  Scalar-mul ladders
+        are mostly doublings, so this is a ~25% cut on their op count.
+        (GeneralCurveOps overrides this: the formula is a = 0 only.)"""
+        f, mul_b3 = self.f, self.mul_b3
+        x, y, z = p
+        t0 = f.mul(y, y)                  # Y²
+        z3 = f.mul_small(t0, 8)           # 8Y²
+        t1 = f.mul(y, z)                  # YZ
+        t2 = mul_b3(f.mul(z, z))          # 3bZ²
+        x3 = f.mul(t2, z3)                # 24bY²Z²
+        y3 = f.add(t0, t2)                # Y² + 3bZ²
+        z3 = f.mul(t1, z3)                # 8Y³Z
+        t0 = f.sub(t0, f.mul_small(t2, 3))  # Y² − 9bZ²
+        y3 = f.add(f.mul(t0, y3), x3)     # (Y²−9bZ²)(Y²+3bZ²) + 24bY²Z²
+        x3 = f.mul_small(f.mul(t0, f.mul(x, y)), 2)  # 2XY(Y²−9bZ²)
+        return Point(x3, y3, z3)
 
     def neg(self, p: Point) -> Point:
         return Point(p.x, self.f.neg(p.y), p.z)
@@ -120,33 +154,56 @@ class CurveOps:
     # -- scalar multiplication ----------------------------------------------
 
     def scalar_mul_static(self, p: Point, k: int) -> Point:
-        """p·k for a static Python-int scalar, through the same windowed
-        scan as the per-lane path (bits broadcast across the batch).  (A
-        "sparse" ladder that unrolls doubling runs between set bits looks
-        cheaper on paper, but every unrolled point op is ~1k HLO ops, so
-        it traded a few device selects for a 40s trace+compile per use
-        site.  One scan body keeps the graph compact.)"""
+        """p·k for a static Python-int scalar: signed base-16 digits
+        (table only 0..8·p — negation is a free y-flip) under one scan of
+        4 doublings + 1 table add per digit.  (A "sparse" ladder that
+        unrolls doubling runs between set bits looks cheaper on paper,
+        but every unrolled point op is ~1k HLO ops, so it traded a few
+        device selects for a 40s trace+compile per use site.  One scan
+        body keeps the graph compact.)"""
         if k < 0:
             return self.scalar_mul_static(self.neg(p), -k)
         if k == 0:
             return self.infinity_like(p.x)
-        bits = [int(c) for c in bin(k)[2:]]
-        window = 4
-        bits = [0] * ((-len(bits)) % window) + bits
+        digs = _signed_base16_digits(k)  # MSB-first, in [-8, 8]
         batch_rank = p.x.ndim - self._coord_rank()
         batch_shape = p.x.shape[:batch_rank]
-        barr = jnp.broadcast_to(jnp.asarray(bits, jnp.int32),
-                                batch_shape + (len(bits),))
-        return self.scalar_mul_bits(p, barr, window=window)
+        table = self._signed_table(p)
+        dig_arr = jnp.asarray([abs(d) for d in digs], jnp.int32)
+        sgn_arr = jnp.asarray([d < 0 for d in digs], bool)
 
-    def _window_table(self, p: Point, window: int):
-        """[0·p, 1·p, ..., (2^w −1)·p] stacked on a new leading axis."""
-        tables = [self.infinity_like(p.x), p]
-        for _ in range(2, 1 << window):
-            tables.append(self.add(tables[-1], p))
-        return Point(jnp.stack([t.x for t in tables]),
-                     jnp.stack([t.y for t in tables]),
-                     jnp.stack([t.z for t in tables]))
+        def step(acc, dd):
+            d, s = dd
+            for _ in range(4):
+                acc = self.dbl(acc)
+            t = self._table_lookup(table, jnp.broadcast_to(d, batch_shape))
+            t = self.select(jnp.broadcast_to(s, batch_shape),
+                            self.neg(t), t)
+            return self.add(acc, t), None
+
+        acc, _ = lax.scan(step, self.infinity_like(p.x),
+                          (dig_arr, sgn_arr))
+        return acc
+
+    def _build_table(self, p: Point, count: int) -> Point:
+        """[0·p, 1·p, ..., (count−1)·p] stacked on a new leading axis;
+        even entries come from the cheaper dedicated doubling."""
+        ts = [self.infinity_like(p.x), p]
+        for k in range(2, count):
+            ts.append(self.dbl(ts[k // 2]) if k % 2 == 0
+                      else self.add(ts[-1], p))
+        return Point(jnp.stack([t.x for t in ts]),
+                     jnp.stack([t.y for t in ts]),
+                     jnp.stack([t.z for t in ts]))
+
+    def _window_table(self, p: Point, window: int) -> Point:
+        return self._build_table(p, 1 << window)
+
+    def _signed_table(self, p: Point) -> Point:
+        """The table for signed base-16 digits: entries 0..8 only (4
+        doublings + 3 adds); −8..−1 come free as y-negations at lookup
+        time."""
+        return self._build_table(p, 9)
 
     def _table_lookup(self, table: Point, digit: Array) -> Point:
         """Per-lane table row selection by digit — a one-hot contraction
@@ -178,7 +235,7 @@ class CurveOps:
             bits_scan = jnp.moveaxis(bits, -1, 0)  # (nbits, ...batch)
 
             def step(acc, bit):
-                acc = self.add(acc, acc)
+                acc = self.dbl(acc)
                 acc = self.select(bit.astype(bool), self.add(acc, p), acc)
                 return acc, None
 
@@ -194,11 +251,72 @@ class CurveOps:
 
         def wstep(acc, digit):
             for _ in range(window):
-                acc = self.add(acc, acc)
+                acc = self.dbl(acc)
             return self.add(acc, self._table_lookup(table, digit)), None
 
         acc, _ = lax.scan(wstep, self.infinity_like(p.x), digits)
         return acc
+
+    def msm_bits(self, p: Point, bits: Array) -> Point:
+        """Σᵢ kᵢ·pᵢ over the leading batch axis with per-lane scalars as
+        an MSB-first bit array (B, nbits) — the fused, fast form of
+        ``tree_sum(scalar_mul_bits(p, bits))``.
+
+        Digit-plane decomposition:  Σᵢ kᵢpᵢ = Σ_w 16^w · Σᵢ d_{i,w}·pᵢ
+        with a signed base-16 recode (digits in [−8, 8], table 0..8·pᵢ,
+        negative digits are y-flips at lookup).  Per window the inner sum
+        is ONE one-hot table lookup per lane plus one batched tree
+        reduction; the 16^w weighting collapses to a width-1 Horner scan
+        (4 doublings + 1 add per window on a single point).  Point-op
+        count per lane: ~7 table + W lookups + W tree adds (W = nbits/4
+        + 1) ≈ 24 for 64-bit scalars — vs ~95 for the windowed ladder +
+        tree, whose per-lane doubling runs dominate.  This is the
+        TPU-native shape of Pippenger's bucket MSM: buckets would need
+        data-dependent scatters, digit planes need only selects and a
+        tree — same asymptotic win, SIMD-friendly.
+
+        Returns a leading-axis-1 point (same contract as tree_sum)."""
+        nbits = bits.shape[-1]
+        assert nbits % 4 == 0 and bits.ndim == p.x.ndim - self._coord_rank() \
+            + 1, "bits must be (batch, nbits) over a 1-D point batch"
+        w0 = nbits // 4
+        weights = jnp.asarray([8, 4, 2, 1], jnp.int32)
+        vals = (bits.reshape(bits.shape[:-1] + (w0, 4)) * weights).sum(-1)
+        vals_lsb = jnp.moveaxis(jnp.flip(vals, axis=-1), -1, 0)  # (w0, B)
+
+        def recode(carry, v):
+            t = v + carry
+            over = t > 8
+            return over.astype(jnp.int32), jnp.where(over, t - 16, t)
+
+        carry, digs = lax.scan(
+            recode, jnp.zeros(bits.shape[:-1], jnp.int32), vals_lsb)
+        digs = jnp.concatenate([digs, carry[None]], axis=0)  # (W, B) LSB-1st
+
+        table = self._signed_table(p)  # (9, B) points
+        planes = []
+        for w in range(w0 + 1):
+            s = self._table_lookup(table, jnp.abs(digs[w]))
+            s = Point(s.x, self.f.where(digs[w] < 0, self.f.neg(s.y), s.y),
+                      s.z)
+            planes.append(s)
+        # (B, W) points: batch leading so tree_sum reduces lanes and
+        # carries the window axis along.
+        sp = Point(jnp.stack([s.x for s in planes], axis=1),
+                   jnp.stack([s.y for s in planes], axis=1),
+                   jnp.stack([s.z for s in planes], axis=1))
+        red = self.tree_sum(sp)               # (1, W) point
+        sw = Point(red.x[0], red.y[0], red.z[0])  # (W,) LSB-first
+
+        def horner(acc, s):
+            for _ in range(4):
+                acc = self.dbl(acc)
+            return self.add(acc, s), None
+
+        acc, _ = lax.scan(
+            horner, self.infinity_like(sw.x[0]),
+            Point(jnp.flip(sw.x, 0), jnp.flip(sw.y, 0), jnp.flip(sw.z, 0)))
+        return Point(acc.x[None], acc.y[None], acc.z[None])
 
     # -- reductions ----------------------------------------------------------
 

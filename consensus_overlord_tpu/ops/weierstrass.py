@@ -94,6 +94,11 @@ class GeneralCurveOps(CurveOps):
         z3 = f.add(f.mul(t5, z3), f.mul(t3, t1))
         return Point(x3, y3, z3)
 
+    def dbl(self, p: Point) -> Point:
+        """The base-class dedicated doubling is a = 0 only; fall back to
+        the general-a complete addition."""
+        return self.add(p, p)
+
     def on_curve(self, p: Point) -> Array:
         """3·Y²Z == 3·X³ + 3a·XZ² + 3b·Z³ (identity passes)."""
         f = self.f
@@ -141,7 +146,7 @@ def dual_scalar_mul_bits(ops: CurveOps, g: Point, g_bits: Array,
     def step(acc, dd):
         dg, dq = dd
         for _ in range(window):
-            acc = ops.add(acc, acc)
+            acc = ops.dbl(acc)
         acc = ops.add(acc, ops._table_lookup(tg, dg))
         acc = ops.add(acc, ops._table_lookup(tq, dq))
         return acc, None

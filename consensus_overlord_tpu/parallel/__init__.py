@@ -12,13 +12,29 @@ range across devices plays the role tensor parallelism plays in ML stacks.
 """
 
 from .multihost import global_mesh, init_multihost  # noqa: F401
-from .sharded import (  # noqa: F401
-    make_mesh,
-    sharded_g1_validate_sum,
-    sharded_g1_verify_msm,
-    sharded_g2_msm,
-    sharded_g2_sum,
-    sharded_g2_validate,
-    sharded_round_step,
-    sharded_verify_round,
+
+_SHARDED = (
+    "make_mesh",
+    "sharded_g1_validate_sum",
+    "sharded_g2_sum_rows",
+    "sharded_g2_validate",
+    "sharded_round_step",
+    "sharded_verify_round",
+    "sharded_verify_round_multi",
 )
+
+__all__ = ["global_mesh", "init_multihost", *_SHARDED]
+
+
+def __getattr__(name):
+    """Lazy kernel imports: `.sharded` pulls in the device op modules,
+    whose import builds jnp constants and therefore initializes the XLA
+    backend.  Multi-host workers must import `init_multihost` and join
+    the jax.distributed runtime BEFORE that happens (jax refuses
+    otherwise), so the kernel surface loads on first use instead of at
+    package import."""
+    if name in _SHARDED:
+        from . import sharded
+
+        return getattr(sharded, name)
+    raise AttributeError(name)

@@ -68,54 +68,30 @@ def _combine_replicated(curve, partial_pt: Point, axis: str) -> Point:
     return curve.tree_sum(flat)
 
 
-def _g1_local_msm(x, sign, inf, ok, bits):
-    pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
-    valid = valid & ~inf & dev.g1_in_subgroup(pt)
-    pt = dev.G1.select(valid, pt, dev.G1.infinity_like(x))
-    return dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits)), valid
-
-
-def sharded_g1_verify_msm(mesh: Mesh, axis: str = AXIS):
-    """Batched G1 signature validate + Σ r_i·S_i over the mesh.
-    Global batch must divide the mesh axis size.  Returns a jitted fn:
-    (x, sign, inf, ok, bits) → (affine x, affine y, is_inf, valid)."""
-
-    @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
-             out_specs=(P(), P(), P(), P(axis)))
-    def fn(x, sign, inf, ok, bits):
-        partial_sum, valid = _g1_local_msm(x, sign, inf, ok, bits)
-        total = _combine_replicated(dev.G1, partial_sum, axis)
-        ax, ay, ainf = dev.G1.to_affine(total)
-        return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid
-
-    return jax.jit(fn)
-
-
 def sharded_verify_round(mesh: Mesh, axis: str = AXIS):
     """The fused single-dispatch verification step over the mesh (the
-    sharded twin of tpu_provider.verify_round_fn): lanes shard, each
-    device validates — including the PER-LANE subgroup check — and
-    locally reduces its G1/G2 shards, then partials combine over ICI —
-    one SPMD program, strict replicated outputs, sharded validity."""
+    sharded twin of tpu_provider.verify_round_fn): signature lanes,
+    packed weights, and pubkey-row indices shard; the device-resident
+    pubkey cache is REPLICATED (P()) so each device gathers its shard's
+    rows locally — no collective for the gather, one all-gather of D
+    partial MSM points over ICI at the end.  Strict replicated
+    aggregates, sharded validity."""
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis),) * 8,
+             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
+                       P(axis), P(), P(), P()),
              out_specs=(P(), P(), P(), P(axis), P(), P(), P()))
-    def fn(x, sign, inf, ok, bits, px, py, pz):
-        pt, valid = dev.g1_decompress_device(x, sign, inf, ok)
+    def fn(x, sign, inf, ok, wpacked, rows, pkx, pky, pkz):
+        bits = dev.unpack_weight_bits(wpacked)
         # Subgroup check stays PER-LANE — a batched residual check on
         # the aggregate is unsound for the cofactor's small-torsion
-        # subgroups (see tpu_provider.verify_round_fn docstring).
-        valid = valid & ~inf & dev.g1_in_subgroup(pt)
-        pt = dev.G1.select(valid, pt, dev.G1.infinity_like(x))
-        agg = _combine_replicated(
-            dev.G1, dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits)), axis)
+        # subgroups (see ops/bls12381_groups.py NOTE).
+        pt, valid = dev.g1_validate_batch(x, sign, inf, ok)
+        agg = _combine_replicated(dev.G1, dev.G1.msm_bits(pt, bits), axis)
         ax, ay, ainf = dev.G1.to_affine(agg)
         vbits = bits * valid[..., None].astype(bits.dtype)
-        gagg = _combine_replicated(
-            dev.G2, dev.G2.tree_sum(
-                dev.G2.scalar_mul_bits(Point(px, py, pz), vbits)), axis)
+        pk = dev.gather_rows(rows, pkx, pky, pkz)
+        gagg = _combine_replicated(dev.G2, dev.G2.msm_bits(pk, vbits), axis)
         gx, gy, ginf = dev.G2.to_affine(gagg)
         return (dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid,
                 dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0])
@@ -123,15 +99,62 @@ def sharded_verify_round(mesh: Mesh, axis: str = AXIS):
     return jax.jit(fn)
 
 
-def sharded_g2_msm(mesh: Mesh, axis: str = AXIS):
-    """Σ r_i·P_i over pre-validated G2 points sharded on the mesh."""
+def sharded_verify_round_multi(mesh: Mesh, axis: str = AXIS):
+    """k-hash fused verification round over the mesh (sharded twin of
+    tpu_provider.verify_round_multi_fn): the group-membership mask
+    shards along the lane axis with the batch; one G2 partial MSM per
+    group combines over ICI.  out_specs depend on the (static) group
+    count k, so one jitted program is built per k on demand, keyed by
+    gmask.shape[0]."""
+    cache = {}
+
+    def call(x, sign, inf, ok, wpacked, rows, gmask, pkx, pky, pkz):
+        k = gmask.shape[0]
+        if k not in cache:
+            def body(x, sign, inf, ok, wpacked, rows, gmask,
+                     pkx, pky, pkz):
+                bits = dev.unpack_weight_bits(wpacked)
+                pt, valid = dev.g1_validate_batch(x, sign, inf, ok)
+                agg = _combine_replicated(dev.G1, dev.G1.msm_bits(pt, bits),
+                                          axis)
+                ax, ay, ainf = dev.G1.to_affine(agg)
+                pk = dev.gather_rows(rows, pkx, pky, pkz)
+                outs = [dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]),
+                        ainf[0], valid]
+                for g in range(k):
+                    m = valid & gmask[g]
+                    vbits = bits * m[..., None].astype(bits.dtype)
+                    gagg = _combine_replicated(
+                        dev.G2, dev.G2.msm_bits(pk, vbits), axis)
+                    gx, gy, ginf = dev.G2.to_affine(gagg)
+                    outs += [dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]),
+                             ginf[0]]
+                return tuple(outs)
+
+            out_specs = (P(), P(), P(), P(axis)) + (P(), P(), P()) * k
+            cache[k] = jax.jit(shard_map(
+                body, mesh=mesh,
+                in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
+                          P(axis), P(None, axis), P(), P(), P()),
+                out_specs=out_specs))
+        return cache[k](x, sign, inf, ok, wpacked, rows, gmask,
+                        pkx, pky, pkz)
+
+    return call
+
+
+def sharded_g2_sum_rows(mesh: Mesh, axis: str = AXIS):
+    """Σ P_i over cached pubkey rows (QC pubkey aggregation, reference
+    src/consensus.rs:365-383): row indices + mask shard, the cache is
+    replicated, partial sums combine over ICI."""
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis), P(axis), P(axis), P(axis)),
+             in_specs=(P(axis), P(axis), P(), P(), P()),
              out_specs=(P(), P(), P()))
-    def fn(px, py, pz, bits):
-        local = dev.G2.tree_sum(
-            dev.G2.scalar_mul_bits(Point(px, py, pz), bits))
+    def fn(rows, mask, pkx, pky, pkz):
+        pk = dev.gather_rows(rows, pkx, pky, pkz)
+        pk = dev.G2.select(mask, pk, dev.G2.infinity_like(pk.x))
+        local = dev.G2.tree_sum(pk)
         total = _combine_replicated(dev.G2, local, axis)
         ax, ay, ainf = dev.G2.to_affine(total)
         return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0]
@@ -174,22 +197,6 @@ def sharded_g1_validate_sum(mesh: Mesh, axis: str = AXIS):
     return jax.jit(fn)
 
 
-def sharded_g2_sum(mesh: Mesh, axis: str = AXIS):
-    """Σ P_i over pre-validated G2 points sharded on the mesh (QC pubkey
-    aggregation, reference src/consensus.rs:365-383)."""
-
-    @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis), P(axis), P(axis)),
-             out_specs=(P(), P(), P()))
-    def fn(px, py, pz):
-        local = dev.G2.tree_sum(Point(px, py, pz))
-        total = _combine_replicated(dev.G2, local, axis)
-        ax, ay, ainf = dev.G2.to_affine(total)
-        return dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0]
-
-    return jax.jit(fn)
-
-
 def sharded_round_step(mesh: Mesh, axis: str = AXIS):
     """The full per-round crypto step (the framework's "training step"):
     validate N vote signatures, reduce Σ r_i·S_i (G1) and Σ r_i·P_i (G2)
@@ -211,10 +218,10 @@ def sharded_round_step(mesh: Mesh, axis: str = AXIS):
         pt = dev.G1.select(valid, pt, dev.G1.infinity_like(sx))
         # Random-linear-combination sums for batch verification.
         g1_rlc = _combine_replicated(
-            dev.G1, dev.G1.tree_sum(dev.G1.scalar_mul_bits(pt, bits)), axis)
+            dev.G1, dev.G1.msm_bits(pt, bits), axis)
         pk = Point(px, py, pz)
         g2_rlc = _combine_replicated(
-            dev.G2, dev.G2.tree_sum(dev.G2.scalar_mul_bits(pk, bits)), axis)
+            dev.G2, dev.G2.msm_bits(pk, bits), axis)
         # Plain signature aggregation (the QC the leader broadcasts).
         qc = _combine_replicated(dev.G1, dev.G1.tree_sum(pt), axis)
         ax1, ay1, ai1 = dev.G1.to_affine(g1_rlc)

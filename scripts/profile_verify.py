@@ -4,8 +4,9 @@ Usage:  python scripts/profile_verify.py [N]
 
 Stages timed separately (each with block_until_ready):
   parse      — host parse of N compressed G1 sigs
-  g1_msm     — device decompress+validate+RLC-MSM over signatures
-  g2_msm     — device RLC-MSM over cached pubkey rows
+  round      — the fused device kernel (G1 validate+MSM, pubkey-cache
+               gather + G2 MSM) INCLUDING the H2D upload + dispatch
+  readback   — device_get of the round outputs
   pairing    — host 2-pairing batch check (native backend if built)
   full       — end-to-end provider.verify_batch
 """
@@ -29,7 +30,7 @@ def timeit(label, fn, iters=4):
     for _ in range(iters):
         out = fn()
     dt = (time.perf_counter() - t0) / iters
-    print(f"{label:12s} {dt * 1e3:9.2f} ms")
+    print(f"{label:12s} {dt * 1e3:9.2f} ms", flush=True)
     return out, dt
 
 
@@ -38,12 +39,11 @@ def main():
     enable()
     import jax.numpy as jnp
 
-    from consensus_overlord_tpu.core.sm3 import sm3_hash
     from consensus_overlord_tpu.crypto import bls12381 as oracle
     from consensus_overlord_tpu.crypto import tpu_provider as tp
     from consensus_overlord_tpu.ops import bls12381_groups as dev
 
-    print(f"device: {jax.devices()[0].platform}  N={N}")
+    print(f"device: {jax.devices()[0].platform}  N={N}", flush=True)
     # Reuse bench.py's fixture (same cache file + message) so the two
     # tools can never drift apart on what they measure.
     import bench
@@ -54,46 +54,22 @@ def main():
     provider.update_pubkeys(pks)
 
     parsed, _ = timeit("parse", lambda: dev.parse_g1_compressed(sigs))
-    size = provider._pad_to(N)
+    prep, _ = timeit("host_prep", lambda: provider._host_prep(sigs, pks, N))
 
-    x = np.zeros((size, dev.FQ.n), np.int32)
-    x[:N] = parsed.x
-    sgn = np.zeros(size, bool)
-    sgn[:N] = parsed.sign
-    inf = np.zeros(size, bool)
-    ok = np.zeros(size, bool)
-    ok[:N] = parsed.wellformed
-    bits = np.zeros((size, tp._SCALAR_BITS), np.int32)
-    bits[:N] = np.unpackbits(
-        np.frombuffer(os.urandom(N * tp._SCALAR_BITS // 8), np.uint8)
-        .reshape(N, -1), axis=1)
-
-    def g1():
-        out = provider._kernels.g1_validate_msm(
-            jnp.asarray(x), jnp.asarray(sgn), jnp.asarray(inf),
-            jnp.asarray(ok), jnp.asarray(bits))
+    def round_blocked():
+        out = provider._kernels.verify_round(
+            jnp.asarray(prep[1]), jnp.asarray(prep[2]), jnp.asarray(prep[3]),
+            jnp.asarray(prep[4]), jnp.asarray(prep[5]), jnp.asarray(prep[6]),
+            *provider._pk_device())
         jax.block_until_ready(out)
         return out
 
-    (ax, ay, ainf, valid), g1_dt = timeit("g1_msm", g1)
+    out, _ = timeit("round", round_blocked)
+    timeit("readback", lambda: jax.device_get(out))
 
-    rows = provider._pk_rows_of(pks)
-    pad_rows = np.zeros(size, np.int64)
-    pad_rows[:N] = rows
-    px, py, pz = (provider._pk_px[pad_rows], provider._pk_py[pad_rows],
-                  provider._pk_pz[pad_rows])
-
-    def g2():
-        out = provider._kernels.g2_msm(
-            jnp.asarray(px), jnp.asarray(py), jnp.asarray(pz),
-            jnp.asarray(bits))
-        jax.block_until_ready(out)
-        return out
-
-    (gax, gay, gainf), g2_dt = timeit("g2_msm", g2)
-
+    ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
     agg_sig = tp._affine_to_oracle_g1(ax, ay, ainf)
-    agg_pk = tp._affine_to_oracle_g2(gax, gay, gainf)
+    agg_pk = tp._affine_to_oracle_g2(gx, gy, ginf)
     h_pt = oracle.hash_to_g1(h, b"")
     neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
     timeit("pairing", lambda: oracle.multi_pairing_is_one(
@@ -102,7 +78,7 @@ def main():
 
     _, full_dt = timeit("full", lambda: provider.verify_batch(
         sigs, [h] * N, pks), iters=2)
-    print(f"rate: {N / full_dt:.0f} verifies/s")
+    print(f"rate: {N / full_dt:.0f} verifies/s", flush=True)
 
 
 if __name__ == "__main__":

@@ -207,3 +207,54 @@ class TestEndomorphismSubgroupChecks:
         psi_q = (oracle.fq2_mul(oracle.fq2_conj(q[0]), _PSI_CX_INT),
                  oracle.fq2_mul(oracle.fq2_conj(q[1]), _PSI_CY_INT))
         assert psi_q == oracle.g2_mul(q, z % oracle.R)
+
+
+class TestMsmBits:
+    """msm_bits (the digit-plane MSM under the RLC batch verification)
+    must agree bit-for-bit with tree_sum(scalar_mul_bits(...)) and the
+    oracle's linear combination for every scalar shape the provider
+    generates (64-bit weights, zero-weight padding lanes, infinity
+    lanes)."""
+
+    def _scalars(self):
+        ks = [RNG.randrange(2**64) for _ in range(8)]
+        ks[2] = 0                 # padding lane weight
+        ks[5] = 2**64 - 1         # max recode carry chain
+        ks[6] = 1
+        return ks
+
+    def test_g1_msm_vs_oracle(self):
+        pts = rand_g1(8)
+        ks = self._scalars()
+        bits = int_to_bits_msb(ks, 64)
+        dev_pts = g1_from_oracle(pts)
+        (got,) = g1_to_oracle(G1.msm_bits(dev_pts, bits))
+        (old,) = g1_to_oracle(
+            G1.tree_sum(G1.scalar_mul_bits(dev_pts, bits)))
+        want = None
+        for p, k in zip(pts, ks):
+            want = oracle.g1_add(want, oracle.g1_mul(p, k))
+        assert got == old == want
+
+    def test_g2_msm_vs_oracle(self):
+        pts = rand_g2(8)
+        ks = self._scalars()
+        bits = int_to_bits_msb(ks, 64)
+        (got,) = g2_to_oracle(G2.msm_bits(g2_from_oracle(pts), bits))
+        want = None
+        for p, k in zip(pts, ks):
+            want = oracle.g2_add(want, oracle.g2_mul(p, k))
+        assert got == want
+
+    def test_infinity_lanes_and_all_zero(self):
+        pts = [None, None] + rand_g1(2)
+        ks = self._scalars()[:4]
+        bits = int_to_bits_msb(ks, 64)
+        (got,) = g1_to_oracle(G1.msm_bits(g1_from_oracle(pts), bits))
+        want = None
+        for p, k in zip(pts, ks):
+            want = oracle.g1_add(want, oracle.g1_mul(p, k) if p else None)
+        assert got == want
+        zero = int_to_bits_msb([0, 0, 0, 0], 64)
+        (z,) = g1_to_oracle(G1.msm_bits(g1_from_oracle(pts), zero))
+        assert z is None

@@ -11,7 +11,7 @@ from consensus_overlord_tpu.crypto import bls12381 as oracle
 from consensus_overlord_tpu.ops import bls12381_groups as dev
 from consensus_overlord_tpu.ops.curve import int_to_bits_msb
 from consensus_overlord_tpu.parallel import (
-    make_mesh, sharded_g1_verify_msm, sharded_round_step)
+    make_mesh, sharded_round_step, sharded_verify_round)
 
 RNG = random.Random(0x5A)
 B = 16
@@ -28,23 +28,38 @@ def fixture_data():
     return msg, sigs, pks, scalars
 
 
-def test_sharded_g1_msm_matches_oracle(fixture_data):
+def test_sharded_verify_round_matches_oracle(fixture_data):
+    """The production fused kernel over the 8-device mesh: 2 lanes per
+    device, pubkey cache replicated + gathered by sharded row index —
+    both MSM aggregates must equal the oracle's linear combinations."""
     msg, sigs, pks, scalars = fixture_data
     assert len(jax.devices()) >= 8
     mesh = make_mesh(8)
-    fn = sharded_g1_verify_msm(mesh)
+    fn = sharded_verify_round(mesh)
     parsed = dev.parse_g1_compressed(sigs)
-    bits = int_to_bits_msb(scalars, NBITS)
-    ax, ay, ainf, valid = fn(
+    wpacked = jnp.asarray(np.frombuffer(
+        b"".join(r.to_bytes(8, "big") for r in scalars),
+        np.uint8).reshape(B, 8))
+    pks_aff = [oracle.g2_decompress(p) for p in pks]
+    pk_pt = dev.g2_from_oracle(pks_aff)
+    rows = jnp.asarray(np.arange(B, dtype=np.int64))
+    ax, ay, ainf, valid, gx, gy, ginf = fn(
         jnp.asarray(parsed.x), jnp.asarray(parsed.sign),
         jnp.asarray(parsed.infinity), jnp.asarray(parsed.wellformed),
-        bits)
+        wpacked, rows, pk_pt.x, pk_pt.y, pk_pt.z)
     assert list(np.asarray(valid)) == [True] * B
     want = None
     for s, r in zip(sigs, scalars):
         want = oracle.g1_add(want, oracle.g1_mul(oracle.g1_decompress(s), r))
-    got = (dev.FQ.to_ints(ax)[0], dev.FQ.to_ints(ay)[0])
+    got = (dev.FQ.ints_from_strict(np.asarray(ax))[0],
+           dev.FQ.ints_from_strict(np.asarray(ay))[0])
     assert got == want
+    want2 = None
+    for p, r in zip(pks_aff, scalars):
+        want2 = oracle.g2_add(want2, oracle.g2_mul(p, r))
+    got2 = (tuple(dev.FQ.ints_from_strict(np.asarray(gx))),
+            tuple(dev.FQ.ints_from_strict(np.asarray(gy))))
+    assert got2 == want2
 
 
 def test_sharded_round_step_runs_and_aggregates(fixture_data):

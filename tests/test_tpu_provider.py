@@ -180,9 +180,43 @@ class TestAsyncPipeline:
         assert r1() == [True] * N
         assert r2() == [True, False, True, True, True, True]
 
-    def test_async_multi_hash_falls_back_sync(self, cpus, tpu):
+    def test_async_multi_hash_fused(self, cpus, tpu):
+        """2–4 distinct hashes dispatch as ONE fused multi-group kernel
+        (no silent degradation to a blocking path)."""
         h1, h2 = sm3_hash(b"x1"), sm3_hash(b"x2")
         sigs = [c.sign(h1) for c in cpus[:3]] + [c.sign(h2) for c in cpus[3:]]
         hashes = [h1] * 3 + [h2] * (N - 3)
         voters = [c.pub_key for c in cpus]
         assert tpu.verify_batch_async(sigs, hashes, voters)() == [True] * N
+
+    def test_async_multi_hash_fused_bad_lane(self, cpus, tpu):
+        h1, h2, h3 = sm3_hash(b"y1"), sm3_hash(b"y2"), sm3_hash(b"y3")
+        hashes = [h1, h1, h2, h2, h3, h3]
+        sigs = [c.sign(h) for c, h in zip(cpus, hashes)]
+        sigs[3] = cpus[3].sign(sm3_hash(b"evil"))
+        voters = [c.pub_key for c in cpus]
+        got = tpu.verify_batch_async(sigs, hashes, voters)()
+        assert got == [True, True, True, False, True, True]
+
+    def test_async_many_hashes_split(self, cpus, tpu):
+        """>4 distinct hashes split into pipelined single-hash
+        sub-batches, resolved back into lane order."""
+        hashes = [sm3_hash(b"z%d" % i) for i in range(N)]
+        sigs = [c.sign(h) for c, h in zip(cpus, hashes)]
+        voters = [c.pub_key for c in cpus]
+        assert tpu.verify_batch_async(sigs, hashes, voters)() == [True] * N
+        sigs[5] = cpus[5].sign(sm3_hash(b"evil"))
+        got = tpu.verify_batch_async(sigs, hashes, voters)()
+        assert got == [True] * 5 + [False]
+
+    def test_async_aggregate_and_verify_aggregated(self, cpus, tpu):
+        """The QC-path async forms dispatch now and resolve later to the
+        same results as the sync forms (engine awaits these off-loop)."""
+        sigs, hashes, voters = make_votes(cpus, b"qc-async")
+        r_agg = tpu.aggregate_signatures_async(sigs, voters)
+        agg = r_agg()
+        assert agg == tpu.aggregate_signatures(sigs, voters)
+        r_ok = tpu.verify_aggregated_async(agg, hashes[0], voters)
+        r_bad = tpu.verify_aggregated_async(agg, sm3_hash(b"no"), voters)
+        assert r_ok() is True
+        assert r_bad() is False
