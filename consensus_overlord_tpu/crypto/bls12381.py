@@ -24,6 +24,7 @@ posture, keys stay host-side (SURVEY.md §7 hard-parts note e).
 
 from __future__ import annotations
 
+import functools
 from typing import Iterable, List, Sequence, Tuple
 
 from ..core.sm3 import sm3_hash
@@ -657,8 +658,23 @@ def g2_decompress(data: bytes):
 # scheme surface.
 # --------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=4096)
 def hash_to_g1(message: bytes, domain: bytes = b""):
-    """Deterministic map bytes → G1 r-torsion point."""
+    """Deterministic map bytes → G1 r-torsion point: RFC 9380
+    BLS12381G1_XMD:SHA-256_SSWU_RO_ (crypto/hash_to_curve.py) — the
+    standards hash the reference reaches through blst's hash-to-curve
+    (src/consensus.rs:390-395).  `domain` is the DST; the reference's
+    hard-coded common_ref = "" (src/consensus.rs:351) maps to the
+    standard basic-scheme ciphersuite tag.  lru-cached: every verify of
+    a batch on the same vote hash re-derives the same point."""
+    from .hash_to_curve import DEFAULT_DST, hash_to_curve_g1
+    return hash_to_curve_g1(message, domain or DEFAULT_DST)
+
+
+def hash_to_g1_try_increment(message: bytes, domain: bytes = b""):
+    """The round-1/2 try-and-increment map, kept as a non-standard
+    cross-check of scheme-level properties (tests compare both maps'
+    sign/verify behavior; new signatures use SSWU above)."""
     for ctr in range(256):
         seed = domain + message + bytes([ctr])
         h = sm3_hash(seed + b"\x00") + sm3_hash(seed + b"\x01")

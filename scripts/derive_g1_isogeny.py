@@ -1,0 +1,435 @@
+"""Derive the 11-isogeny E' -> E for BLS12-381 G1 SSWU hashing (RFC 9380
+§6.6.2/§8.8.1) from first principles, and print its rational-map
+coefficients as Python literals for crypto/hash_to_curve.py.
+
+Why derive instead of transcribe: the map has 4 polynomials totalling ~50
+96-hex-char coefficients; a transcription error would be silent until a
+cross-implementation interop failure.  Here the coefficients are COMPUTED
+(division polynomial -> rational kernel -> Vélu's formulas) and verified
+structurally (mapped points land on E: y² = x³ + 4; the map is a group
+homomorphism), then pinned by RFC known-answer vectors in
+tests/test_hash_to_curve.py.
+
+Method:
+  1. E': y² = x³ + A'x + B' is the isogenous curve of the ciphersuite
+     (A', B' from RFC 9380 §8.8.1).  Compute its 11-division polynomial
+     ψ₁₁(x) (degree 60) over Fp.
+  2. gcd(x^p − x, ψ₁₁) isolates the x-coordinates of rational 11-torsion;
+     split to roots (Cantor–Zassenhaus), group the roots into order-11
+     subgroups by generating multiples of a lifted point over Fp².
+  3. Vélu's formulas over the kernel give X(x) = X_num/h², Y(x,y) =
+     y·Y_num/h³ and the image curve — which must equal E (b = 4, a = 0)
+     for the right kernel/normalization.
+  4. Print the coefficient lists low-degree-first.
+
+Pure Python, stdlib only; runs in ~1 minute.  Output is baked into
+crypto/hash_to_curve.py (regenerate with: python scripts/derive_g1_isogeny.py).
+"""
+
+import random
+import sys
+
+P = int(
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+    "1eabfffeb153ffffb9feffffffffaaab", 16)
+
+# RFC 9380 §8.8.1: the isogenous curve E' for BLS12381G1_XMD:SHA-256_SSWU_RO_
+A_PRIME = int(
+    "144698a3b8e9433d693a02c96d4982b0ea985383ee66a8d8e8981aefd881ac98"
+    "936f8da0e0f97f5cf428082d584c1d", 16)
+B_PRIME = int(
+    "12e2908d11688030018b12e8753eee3b2016c1f0f24f4070a0b9c14fcef35ef5"
+    "5a23215a316ceaa5d1cc48e98e172be0", 16)
+
+# Target curve E: y² = x³ + 4
+A_E, B_E = 0, 4
+
+
+# -- Fp[x] dense polynomial arithmetic (coefficients low-degree-first) -------
+
+def pnorm(f):
+    while f and f[-1] == 0:
+        f.pop()
+    return f
+
+
+def padd(f, g):
+    n = max(len(f), len(g))
+    return pnorm([((f[i] if i < len(f) else 0) +
+                   (g[i] if i < len(g) else 0)) % P for i in range(n)])
+
+
+def psub(f, g):
+    n = max(len(f), len(g))
+    return pnorm([((f[i] if i < len(f) else 0) -
+                   (g[i] if i < len(g) else 0)) % P for i in range(n)])
+
+
+def pmul(f, g):
+    if not f or not g:
+        return []
+    out = [0] * (len(f) + len(g) - 1)
+    for i, a in enumerate(f):
+        if a:
+            for j, b in enumerate(g):
+                out[i + j] = (out[i + j] + a * b) % P
+    return pnorm(out)
+
+
+def pscale(f, c):
+    return pnorm([a * c % P for a in f])
+
+
+def pdivmod(f, g):
+    f = list(f)
+    q = [0] * max(1, len(f) - len(g) + 1)
+    inv_lead = pow(g[-1], P - 2, P)
+    while len(f) >= len(g):
+        c = f[-1] * inv_lead % P
+        d = len(f) - len(g)
+        q[d] = c
+        for i, b in enumerate(g):
+            f[d + i] = (f[d + i] - c * b) % P
+        pnorm(f)
+        if not f:
+            break
+    return pnorm(q), f
+
+
+def pmod(f, g):
+    return pdivmod(f, g)[1]
+
+
+def pgcd(f, g):
+    while g:
+        f, g = g, pmod(f, g)
+    return pscale(f, pow(f[-1], P - 2, P)) if f else f
+
+
+def ppowmod(f, e, m):
+    r = [1]
+    f = pmod(f, m)
+    while e:
+        if e & 1:
+            r = pmod(pmul(r, f), m)
+        f = pmod(pmul(f, f), m)
+        e >>= 1
+    return r
+
+
+# -- division polynomials of E' (y² = x³ + ax + b) ---------------------------
+
+def division_polys(a, b, upto):
+    """ψ_n as univariate polys: odd n directly; even n as ψ_n / (2y)
+    with y² = f(x) substituted (the standard trick).  Returns dict n→poly
+    plus a parallel dict marking whether the poly carries a factor that
+    must be multiplied by 2y (even index)."""
+    f = [b % P, a % P, 0, 1]  # x³ + ax + b
+    # Representation: odd-index ψ_n stored directly; even-index stored as
+    # ψ̃_n = ψ_n / (2y).  With F = (2y)² = 4f the recurrences close over
+    # stored values:
+    #   n = 2m+1, m even : ψ_n = F²·ψ̃_{m+2}ψ̃_m³ − ψ_{m−1}ψ_{m+1}³
+    #   n = 2m+1, m odd  : ψ_n = ψ_{m+2}ψ_m³ − F²·ψ̃_{m−1}ψ̃_{m+1}³
+    #   n = 2m           : ψ̃_n = s_m·(s_{m+2}·s_{m−1}² − s_{m−2}·s_{m+1}²)
+    #                      (s = stored value; the (2y) factors cancel
+    #                      identically for both parities of m)
+    psi = {0: [], 1: [1], 2: [1]}
+    # ψ3 = 3x⁴ + 6ax² + 12bx − a²
+    psi[3] = pnorm([(-a * a) % P, (12 * b) % P, (6 * a) % P, 0, 3])
+    # ψ̃4 = 2(x⁶ + 5ax⁴ + 20bx³ − 5a²x² − 4abx − 8b² − a³)
+    psi[4] = pscale(pnorm([(-8 * b * b - a ** 3) % P, (-4 * a * b) % P,
+                           (-5 * a * a) % P, (20 * b) % P, (5 * a) % P,
+                           0, 1]), 2)
+    F = pscale(f, 4)
+    F2 = pmul(F, F)
+    for n in range(5, upto + 1):
+        m = n // 2
+        if n % 2 == 1:
+            t1 = pmul(psi[m + 2], pmul(psi[m], pmul(psi[m], psi[m])))
+            t2 = pmul(psi[m - 1], pmul(psi[m + 1],
+                                       pmul(psi[m + 1], psi[m + 1])))
+            if m % 2 == 0:
+                psi[n] = psub(pmul(t1, F2), t2)
+            else:
+                psi[n] = psub(t1, pmul(t2, F2))
+        else:
+            t1 = pmul(psi[m + 2], pmul(psi[m - 1], psi[m - 1]))
+            t2 = pmul(psi[m - 2], pmul(psi[m + 1], psi[m + 1]))
+            psi[n] = pmul(psi[m], psub(t1, t2))
+    return psi
+
+
+# -- root finding ------------------------------------------------------------
+
+def roots_of(fpoly):
+    """All Fp roots of fpoly (Cantor–Zassenhaus on the linear-factor part)."""
+    xp = ppowmod([0, 1], P, fpoly)
+    lin = pgcd(psub(xp, [0, 1]), fpoly)
+    out = []
+
+    def split(g):
+        if len(g) == 2:  # x + c
+            out.append((-g[0]) * pow(g[1], P - 2, P) % P)
+            return
+        if len(g) <= 1:
+            return
+        while True:
+            delta = random.randrange(P)
+            t = ppowmod([delta, 1], (P - 1) // 2, g)
+            h = pgcd(psub(t, [1]), g)
+            if 0 < len(h) - 1 < len(g) - 1:
+                split(h)
+                split(pdivmod(g, h)[0])
+                return
+
+    split(lin)
+    return sorted(out)
+
+
+# -- Fp² and curve arithmetic over it ---------------------------------------
+
+class F2:
+    """Fp[u]/(u²+1) — enough to lift kernel points whose y lives there."""
+
+    __slots__ = ("a", "b")
+
+    def __init__(self, a, b=0):
+        self.a, self.b = a % P, b % P
+
+    def __add__(s, o):
+        return F2(s.a + o.a, s.b + o.b)
+
+    def __sub__(s, o):
+        return F2(s.a - o.a, s.b - o.b)
+
+    def __mul__(s, o):
+        return F2(s.a * o.a - s.b * o.b, s.a * o.b + s.b * o.a)
+
+    def __eq__(s, o):
+        return s.a == o.a and s.b == o.b
+
+    def inv(s):
+        d = pow((s.a * s.a + s.b * s.b) % P, P - 2, P)
+        return F2(s.a * d, -s.b * d)
+
+    def sqrt(s):
+        """Square root in Fp² (complex method); None if non-square."""
+        if s.b == 0:
+            r = pow(s.a, (P + 1) // 4, P)
+            if r * r % P == s.a:
+                return F2(r)
+            # sqrt(a) = sqrt(-a)·u
+            r = pow((-s.a) % P, (P + 1) // 4, P)
+            if r * r % P == (-s.a) % P:
+                return F2(0, r)
+            return None
+        norm = (s.a * s.a + s.b * s.b) % P
+        n = pow(norm, (P + 1) // 4, P)
+        if n * n % P != norm:
+            return None
+        for sgn in (1, -1):
+            alpha = (s.a + sgn * n) % P * pow(2, P - 2, P) % P
+            t = pow(alpha, (P + 1) // 4, P)
+            if t * t % P == alpha:
+                if t == 0:
+                    continue
+                c1 = s.b * pow(2 * t % P, P - 2, P) % P
+                cand = F2(t, c1)
+                if cand * cand == s:
+                    return cand
+        return None
+
+
+def ec_add2(p1, p2, a):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) == F2(0):
+            return None
+        lam = (F2(3) * x1 * x1 + F2(a)) * (F2(2) * y1).inv()
+    else:
+        lam = (y2 - y1) * (x2 - x1).inv()
+    x3 = lam * lam - x1 - x2
+    return (x3, lam * (x1 - x3) - y1)
+
+
+def main():
+    random.seed(0xC0FFEE)
+    sys.setrecursionlimit(10000)
+    psi = division_polys(A_PRIME, B_PRIME, 11)
+    psi11 = psi[11]
+    print(f"deg psi11 = {len(psi11) - 1}", file=sys.stderr)
+    xs = roots_of(psi11)
+    print(f"rational 11-torsion x-coords: {len(xs)}", file=sys.stderr)
+
+    # Group roots into order-11 subgroups: lift one root to a point over
+    # Fp², generate its multiples, collect the 5 distinct x-coords.
+    f_of = lambda x: (pow(x, 3, P) + A_PRIME * x + B_PRIME) % P
+    remaining = set(xs)
+    kernels = []
+    while remaining:
+        x0 = next(iter(remaining))
+        y0 = F2(f_of(x0)).sqrt()
+        assert y0 is not None, "y lift failed"
+        q = (F2(x0), y0)
+        pt = q
+        kx = set()
+        for _ in range(5):
+            assert pt is not None
+            assert pt[0].b == 0, "kernel x-coord not rational?"
+            kx.add(pt[0].a)
+            pt = ec_add2(pt, q, A_PRIME)
+        # 6q..10q mirror 5q..1q; the 11th multiple must be O — this is
+        # the division-polynomial correctness check.
+        for _ in range(5):
+            pt = ec_add2(pt, q, A_PRIME)
+        assert pt is None, "lifted kernel point does not have order 11"
+        kernels.append(sorted(kx))
+        remaining -= kx
+    print(f"{len(kernels)} rational order-11 kernel(s)", file=sys.stderr)
+
+    for ker in kernels:
+        # Vélu over the half-kernel S = the 5 x-coords.
+        h = [1]
+        for xq in ker:
+            h = pmul(h, [(-xq) % P, 1])
+        v = w = 0
+        per_q = []
+        for xq in ker:
+            gq = (3 * xq * xq + A_PRIME) % P
+            uq = 4 * f_of(xq) % P
+            vq = 2 * gq % P
+            v = (v + vq) % P
+            w = (w + uq + xq * vq) % P
+            per_q.append((xq, vq, uq))
+        a2 = (A_PRIME - 5 * v) % P
+        b2 = (B_PRIME - 7 * w) % P
+        print(f"kernel -> image curve a={hex(a2)} b={hex(b2)}",
+              file=sys.stderr)
+        if a2 == A_E:
+            break
+    else:
+        raise SystemExit("no kernel gives an a=0 image — check A'/B'")
+
+    # X(x) = [x·h² + Σ (vq·(h/(x−xq))·h + uq·(h/(x−xq))²)] / h²
+    h2 = pmul(h, h)
+    h3 = pmul(h2, h)
+    x_num = pmul([0, 1], h2)
+    y_num = list(h3)
+    for xq, vq, uq in per_q:
+        hq, rem = pdivmod(h, [(-xq) % P, 1])
+        assert not rem
+        hq2 = pmul(hq, hq)
+        hq3 = pmul(hq2, hq)
+        x_num = padd(x_num, pscale(pmul(hq, h), vq))
+        x_num = padd(x_num, pscale(hq2, uq))
+        y_num = psub(y_num, pscale(hq3, 2 * uq % P))
+        y_num = psub(y_num, pscale(pmul(hq2, h), vq))
+    x_den, y_den = h2, h3
+
+    # Compose with the isomorphism (x, y) → (u²x, u³y) taking the Vélu
+    # image y² = x³ + b2 onto E: y² = x³ + 4 (u⁶ = 4/b2).  Six choices of
+    # u (Aut(E) has order 6 at j = 0); the RFC's normalization is pinned
+    # by the known low coefficient of its x_num (k_(1,0), RFC 9380 E.2).
+    K10_RFC = int(
+        "11a05f2b1e833340b809101dd99815856b303e88a2d7005ff2627b56cdb4e2c8"
+        "5610c2d5f2e62d6eaeac1662734649b7", 16)
+    c = 4 * pow(b2, P - 2, P) % P
+    # All six u with u⁶ = c, via the same root finder used on ψ₁₁
+    # (p ≡ 1 mod 9, so no closed-form cube-root exponent exists).
+    candidates = roots_of([(-c) % P, 0, 0, 0, 0, 0, 1])
+    assert candidates, "4/b2 has no sixth root — unexpected twist class"
+    for u in candidates:
+        assert pow(u, 6, P) == c
+    # NOTE: k_(1,0) pins u only up to sign (±u share u²); the y-map sign
+    # is pinned downstream by the RFC known-answer vectors
+    # (tests/test_hash_to_curve.py) — if a regeneration flips them,
+    # negate ISO_Y_NUM mod p.
+    chosen = None
+    for u in candidates:
+        xn = pscale(x_num, u * u % P)
+        if xn[0] == K10_RFC:
+            chosen = u
+            break
+    if chosen is None:
+        print("WARNING: no u matches the RFC k_(1,0) constant; "
+              "candidates' k10 values:", file=sys.stderr)
+        for u in candidates:
+            print(f"  u={hex(u)} k10={hex(pscale(x_num, u*u%P)[0])}",
+                  file=sys.stderr)
+        chosen = candidates[0]
+    u = chosen
+    x_num = pscale(x_num, u * u % P)
+    y_num = pscale(y_num, pow(u, 3, P))
+
+    # -- structural verification over random points of E'(Fp) -------------
+    def eval_poly(f, x):
+        acc = 0
+        for c in reversed(f):
+            acc = (acc * x + c) % P
+        return acc
+
+    def iso(pt):
+        if pt is None:
+            return None
+        x, y = pt
+        d = eval_poly(x_den, x)
+        if d == 0:
+            return None  # kernel point -> infinity
+        X = eval_poly(x_num, x) * pow(d, P - 2, P) % P
+        Y = y * eval_poly(y_num, x) % P * pow(eval_poly(y_den, x),
+                                              P - 2, P) % P
+        return (X, Y)
+
+    def ec_add(p1, p2, a):
+        if p1 is None:
+            return p2
+        if p2 is None:
+            return p1
+        x1, y1 = p1
+        x2, y2 = p2
+        if x1 == x2 and (y1 + y2) % P == 0:
+            return None
+        if x1 == x2:
+            lam = (3 * x1 * x1 + a) * pow(2 * y1, P - 2, P) % P
+        else:
+            lam = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+        x3 = (lam * lam - x1 - x2) % P
+        return (x3, (lam * (x1 - x3) - y1) % P)
+
+    def rand_point():
+        while True:
+            x = random.randrange(P)
+            y2v = f_of(x)
+            y = pow(y2v, (P + 1) // 4, P)
+            if y * y % P == y2v:
+                return (x, y)
+
+    for _ in range(4):
+        pt1, pt2 = rand_point(), rand_point()
+        q1, q2 = iso(pt1), iso(pt2)
+        for (X, Y) in (q1, q2):
+            assert Y * Y % P == (pow(X, 3, P) + 4) % P, "image not on E"
+        lhs = iso(ec_add(pt1, pt2, A_PRIME))
+        rhs = ec_add(q1, q2, 0)
+        assert lhs == rhs, "isogeny is not a homomorphism"
+    print("verified: image on E, homomorphism holds", file=sys.stderr)
+
+    def dump(name, f):
+        print(f"{name} = [")
+        for c in f:
+            print(f"    0x{c:096x},")
+        print("]")
+
+    dump("ISO_X_NUM", x_num)
+    dump("ISO_X_DEN", x_den)
+    dump("ISO_Y_NUM", y_num)
+    dump("ISO_Y_DEN", y_den)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""RFC 9380 hash-to-G1 (SSWU + derived 11-isogeny) known-answer tests.
+
+The isogeny coefficients in crypto/hash_to_curve.py are machine-derived
+(scripts/derive_g1_isogeny.py); these vectors — RFC 9380 Appendix J.9.1
+(BLS12381G1_XMD:SHA-256_SSWU_RO_) and K.1 (expand_message_xmd SHA-256)
+— pin them to the standard byte-for-byte."""
+
+from consensus_overlord_tpu.crypto import bls12381 as oracle
+from consensus_overlord_tpu.crypto.hash_to_curve import (
+    expand_message_xmd, hash_to_curve_g1, map_to_curve_sswu, iso_map)
+
+DST = b"QUUX-V01-CS02-with-BLS12381G1_XMD:SHA-256_SSWU_RO_"
+
+# (msg, P.x, P.y) from RFC 9380 J.9.1
+VECTORS = [
+    (b"",
+     "052926add2207b76ca4fa57a8734416c8dc95e24501772c814278700eed6d1e4"
+     "e8cf62d9c09db0fac349612b759e79a1",
+     "08ba738453bfed09cb546dbb0783dbb3a5f1f566ed67bb6be0e8c67e2e81a4cc"
+     "68ee29813bb7994998f3eae0c9c6a265"),
+    (b"abc",
+     "03567bc5ef9c690c2ab2ecdf6a96ef1c139cc0b2f284dca0a9a7943388a49a3a"
+     "ee664ba5379a7655d3c68900be2f6903",
+     "0b9c15f3fe6e5cf4211f346271d7b01c8f3b28be689c8429c85b67af21553331"
+     "1f0b8dfaaa154fa6b88176c229f2885d"),
+    (b"abcdef0123456789",
+     "11e0b079dea29a68f0383ee94fed1b940995272407e3bb916bbf268c263ddd57"
+     "a6a27200a784cbc248e84f357ce82d98",
+     "03a87ae2caf14e8ee52e51fa2ed8eefe80f02457004ba4d486d6aa1f517c0889"
+     "501dc7413753f9599b099ebcbbd2d709"),
+    (b"q128_" + b"q" * 128,
+     "15f68eaa693b95ccb85215dc65fa81038d69629f70aeee0d0f677cf22285e7bf"
+     "58d7cb86eefe8f2e9bc3f8cb84fac488",
+     "1807a1d50c29f430b8cafc4f8638dfeeadf51211e1602a5f184443076715f91b"
+     "b90a48ba1e370edce6ae1062f5e6dd38"),
+    (b"a512_" + b"a" * 512,
+     "082aabae8b7dedb0e78aeb619ad3bfd9277a2f77ba7fad20ef6aabdc6c31d19b"
+     "a5a6d12283553294c1825c4b3ca2dcfe",
+     "05b84ae5a942248eea39e1d91030458c40153f3b654ab7872d779ad1e942856a"
+     "20c438e8d99bc8abfbf74729ce1f7ac8"),
+]
+
+
+class TestExpandMessageXmd:
+    """RFC 9380 K.1 (SHA-256, DST "QUUX-V01-CS02-with-expander-SHA256-128")."""
+
+    DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+
+    def test_len32(self):
+        assert expand_message_xmd(b"", self.DST, 0x20).hex() == (
+            "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d8"
+            "03f07235")
+        assert expand_message_xmd(b"abc", self.DST, 0x20).hex() == (
+            "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a"
+            "0d605615")
+
+    def test_len128(self):
+        out = expand_message_xmd(b"", self.DST, 0x80)
+        assert len(out) == 0x80
+        assert out.hex().startswith("af84c27ccfd45d41914fdff5df25293e")
+
+
+class TestHashToCurveG1:
+    def test_rfc_vectors(self):
+        for msg, ex, ey in VECTORS:
+            x, y = hash_to_curve_g1(msg, DST)
+            assert f"{x:096x}" == ex, msg
+            assert f"{y:096x}" == ey, msg
+
+    def test_output_in_subgroup(self):
+        for msg in (b"", b"vote-hash", b"\x00" * 32):
+            pt = hash_to_curve_g1(msg, DST)
+            assert oracle.g1_in_subgroup(pt)
+
+    def test_sswu_lands_on_isogenous_curve(self):
+        from consensus_overlord_tpu.crypto.hash_to_curve import (
+            ISO_A, ISO_B, P)
+        for u in (0, 1, 5, P - 2):
+            x, y = map_to_curve_sswu(u)
+            assert y * y % P == (pow(x, 3, P) + ISO_A * x + ISO_B) % P
+
+    def test_iso_map_lands_on_e(self):
+        from consensus_overlord_tpu.crypto.hash_to_curve import P
+        pt = iso_map(map_to_curve_sswu(7))
+        x, y = pt
+        assert y * y % P == (pow(x, 3, P) + 4) % P
+
+
+class TestSchemeIntegration:
+    """hash_to_g1 (now SSWU by default) keeps the sign/verify scheme
+    sound, and the legacy try-and-increment map stays available as a
+    distinct cross-check."""
+
+    def test_sign_verify_roundtrip_sswu(self):
+        h = oracle.sm3_hash(b"block")
+        sig = oracle.sign(0xABCD, h)
+        assert oracle.verify(oracle.sk_to_pk(0xABCD), h, sig)
+        assert not oracle.verify(oracle.sk_to_pk(0xABCD),
+                                 oracle.sm3_hash(b"other"), sig)
+
+    def test_legacy_map_differs_but_scheme_equivalent(self):
+        h = oracle.sm3_hash(b"block")
+        assert oracle.hash_to_g1(h) != oracle.hash_to_g1_try_increment(h)
+        assert oracle.g1_in_subgroup(oracle.hash_to_g1_try_increment(h))
