@@ -33,6 +33,7 @@ reach the device (SURVEY.md §7 hard part (e)).
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -264,6 +265,12 @@ class _EcdsaFamilyCrypto:
         # voter bytes → decompressed affine (or None if invalid), plus
         # device limb rows stacked for vectorized gathers.
         self._pk_index: Dict[bytes, int] = {}
+        # Guards the read-check-append sequence below: the frontier runs
+        # verify_batch calls via asyncio.to_thread (multiple in-flight
+        # flushes), and two threads capturing `base` before either
+        # concatenates would desynchronize index → row mapping (same
+        # hazard TpuBlsCrypto._pk_lock covers).
+        self._pk_lock = threading.Lock()
         f = {"secp256k1": w.FQ_SECP, "sm2": w.FQ_SM2}[self.curve_name]
         self._f = f
         self._pk_x = np.zeros((0, f.n), np.int32)
@@ -400,30 +407,33 @@ class _EcdsaFamilyCrypto:
 
     def _pk_rows_of(self, voters: Sequence[bytes]) -> np.ndarray:
         f = self._f
-        missing = []
-        seen = set()
-        for v in voters:
-            vb = bytes(v)
-            if vb not in self._pk_index and vb not in seen:
-                seen.add(vb)
-                missing.append(vb)
-        if missing:
-            base = self._pk_x.shape[0]
-            xs, ys = [], []
-            for j, vb in enumerate(missing):
-                pt = self.host.decompress(vb)
-                if pt is None:
-                    self._pk_index[vb] = -1
-                    xs.append(np.zeros(f.n, np.int32))
-                    ys.append(np.zeros(f.n, np.int32))
-                else:
-                    self._pk_index[vb] = base + j
-                    xs.append(f.from_int(pt[0]))
-                    ys.append(f.from_int(pt[1]))
-            self._pk_x = np.concatenate([self._pk_x, np.stack(xs)], axis=0)
-            self._pk_y = np.concatenate([self._pk_y, np.stack(ys)], axis=0)
-        return np.fromiter((self._pk_index[bytes(v)] for v in voters),
-                           np.int64, len(voters))
+        with self._pk_lock:
+            missing = []
+            seen = set()
+            for v in voters:
+                vb = bytes(v)
+                if vb not in self._pk_index and vb not in seen:
+                    seen.add(vb)
+                    missing.append(vb)
+            if missing:
+                base = self._pk_x.shape[0]
+                xs, ys = [], []
+                for j, vb in enumerate(missing):
+                    pt = self.host.decompress(vb)
+                    if pt is None:
+                        self._pk_index[vb] = -1
+                        xs.append(np.zeros(f.n, np.int32))
+                        ys.append(np.zeros(f.n, np.int32))
+                    else:
+                        self._pk_index[vb] = base + j
+                        xs.append(f.from_int(pt[0]))
+                        ys.append(f.from_int(pt[1]))
+                self._pk_x = np.concatenate([self._pk_x, np.stack(xs)],
+                                            axis=0)
+                self._pk_y = np.concatenate([self._pk_y, np.stack(ys)],
+                                            axis=0)
+            return np.fromiter((self._pk_index[bytes(v)] for v in voters),
+                               np.int64, len(voters))
 
 
 class Secp256k1Crypto(_EcdsaFamilyCrypto):

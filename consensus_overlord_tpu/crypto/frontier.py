@@ -107,6 +107,48 @@ class BatchingVerifier:
             return True
         return await self.verify(*claims)
 
+    async def verify_aggregated(self, agg_sig: bytes, hash32: bytes,
+                                voters) -> bool:
+        """QC aggregate verification off the event loop: dispatch through
+        the same single ordered worker as batch flushes (device FIFO
+        stays intact), block only in a resolver thread.  The engine
+        awaits this from _verify_qc so a ≥1024-voter QC check never
+        stalls consensus timers on a ~200 ms device round-trip."""
+        dispatch = getattr(self._provider, "verify_aggregated_async", None)
+        try:
+            if dispatch is None:
+                return await asyncio.to_thread(
+                    self._provider.verify_aggregated_signature,
+                    agg_sig, hash32, voters)
+            return await self._via_dispatcher(dispatch, agg_sig, hash32,
+                                              voters)
+        except Exception:  # noqa: BLE001 — malformed input is never fatal
+            logger.exception("frontier QC verification errored")
+            return False
+
+    async def aggregate(self, signatures, voters) -> bytes:
+        """QC signature aggregation off the event loop (leader path).
+        Raises CryptoError on invalid input, like the sync form."""
+        dispatch = getattr(self._provider, "aggregate_signatures_async",
+                           None)
+        if dispatch is None:
+            return await asyncio.to_thread(
+                self._provider.aggregate_signatures, signatures, voters)
+        return await self._via_dispatcher(dispatch, signatures, voters)
+
+    async def _via_dispatcher(self, dispatch, *args):
+        """dispatch(*args) on the ordered worker → resolve() in a second
+        thread (overlaps the dispatch→readback round-trip with device
+        compute, same pipeline as _run_batch)."""
+        loop = asyncio.get_running_loop()
+        resolver = await loop.run_in_executor(self._dispatcher, dispatch,
+                                              *args)
+        return await asyncio.to_thread(resolver)
+
+    def close(self) -> None:
+        """Release the dispatch worker thread (engine/sim teardown)."""
+        self._dispatcher.shutdown(wait=False)
+
     async def _linger_then_flush(self) -> None:
         await asyncio.sleep(self._linger)
         self._flush_now()

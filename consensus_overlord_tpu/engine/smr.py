@@ -173,6 +173,14 @@ class Engine:
     reference src/consensus.rs:352-357)."""
 
     MAX_PENDING = 4096  # future-message buffer bound
+    #: Live vote/choke state is kept only for rounds within this window
+    #: of the current round.  Without it a single valid validator could
+    #: spray votes/chokes for millions of distinct future rounds and
+    #: grow the per-round maps without bound (each costs a _VoteSet /
+    #: dict); honest peers are never this far ahead — anyone legitimately
+    #: beyond the window advances us via f+1 round-skip chokes or a QC
+    #: first.  (tests/test_byzantine.py::test_round_flood_memory_bounded)
+    ROUND_WINDOW = 64
 
     def __init__(self, name: Address, adapter: ConsensusAdapter,
                  crypto: CryptoProvider, wal: Wal,
@@ -251,6 +259,20 @@ class Engine:
             self.lock_round = recovered.lock_round
             self.lock_proposal = recovered.lock_proposal
             self.lock_qc = recovered.lock_qc
+            if start_height > init_height:
+                # The caller's authority list describes init_height; a
+                # WAL ahead of it may span a reconfiguration — refresh
+                # through the chain port (the reference engine's
+                # get_authority_list callback, src/consensus.rs:659-666).
+                try:
+                    fresh = await self.adapter.get_authority_list(
+                        start_height)
+                    if fresh:
+                        self._set_authorities(fresh)
+                except Exception:  # noqa: BLE001 — keep the caller's list
+                    logger.exception(
+                        "%s: get_authority_list failed on recovery",
+                        self._tag())
             if self.lock_proposal is not None:
                 self._contents[self.lock_proposal.block_hash] = \
                     self.lock_proposal.content
@@ -402,6 +424,13 @@ class Engine:
         self.round = round_
         self.step = Step.PROPOSE
         self._cancel_timers()
+        # Drop per-round state that fell out of the live-round window
+        # (memory stays O(ROUND_WINDOW) regardless of round spray).
+        floor = round_ - self.ROUND_WINDOW
+        for rounds_map in (self._prevotes, self._precommits, self._chokes,
+                           self._prevote_qcs, self._proposals):
+            for r in [r for r in rounds_map if r < floor]:
+                del rounds_map[r]
         await self._save_wal()
         logger.debug("%s: enter round %d (leader=%s)", self._tag(), round_,
                      self.leader(self.height, round_)[:4].hex())
@@ -552,7 +581,7 @@ class Engine:
                 sp.signature, sm3_hash(p.encode()), p.proposer):
             logger.warning("%s: bad proposal signature", self._tag())
             return
-        if p.lock is not None and not self._verify_lock_qc(p):
+        if p.lock is not None and not await self._verify_lock_qc(p):
             logger.warning("%s: bad lock QC on proposal", self._tag())
             return
         self._proposals[p.round] = sp
@@ -569,7 +598,7 @@ class Engine:
         self._spawn(self._check_block(p.height, p.round, p.block_hash,
                                       p.content))
 
-    def _verify_lock_qc(self, p: Proposal) -> bool:
+    async def _verify_lock_qc(self, p: Proposal) -> bool:
         qc = p.lock
         if qc is None:
             return True
@@ -577,11 +606,15 @@ class Engine:
             return False
         if qc.round >= p.round or qc.block_hash != p.block_hash:
             return False
-        return self._verify_qc(qc)
+        return await self._verify_qc(qc)
 
-    def _verify_qc(self, qc: AggregatedVote) -> bool:
+    async def _verify_qc(self, qc: AggregatedVote) -> bool:
         """Aggregated-signature + quorum check for a QC (the reference's
-        check_block audit shape, src/consensus.rs:144-207)."""
+        check_block audit shape, src/consensus.rs:144-207).  With a
+        frontier, the device-path aggregate check runs through its
+        ordered off-loop dispatch worker — the mailbox handler awaits the
+        result, but the event loop (timers, peers, the gRPC server)
+        never stalls on the device round-trip."""
         try:
             voters = extract_voters(self.authorities, qc.signature.address_bitmap)
         except ValueError:
@@ -589,6 +622,9 @@ class Engine:
         if self._weight_of(voters) < quorum_weight(self._total_weight()):
             return False
         vote_hash = sm3_hash(qc.to_vote().encode())
+        if self.frontier is not None:
+            return await self.frontier.verify_aggregated(
+                qc.signature.signature, vote_hash, voters)
         return self.crypto.verify_aggregated_signature(
             qc.signature.signature, vote_hash, voters)
 
@@ -655,6 +691,8 @@ class Engine:
             return
         if self.leader(v.height, v.round) != self.name:
             return  # not the relayer for this round
+        if abs(v.round - self.round) > self.ROUND_WINDOW:
+            return  # outside the live-round window (memory bound)
         if not self._is_validator(sv.voter):
             return
         vote_set = (self._prevotes if v.vote_type == VoteType.PREVOTE
@@ -679,8 +717,14 @@ class Engine:
         # Aggregate in sorted-voter order so the signature matches the
         # bitmap extraction order at every verifier.
         pairs = sorted(votes.items())
-        agg_sig = self.crypto.aggregate_signatures(
-            [sig for _, sig in pairs], [voter for voter, _ in pairs])
+        if self.frontier is not None:
+            # Device path off the event loop, through the frontier's
+            # ordered dispatch worker (same pipeline as batch verifies).
+            agg_sig = await self.frontier.aggregate(
+                [sig for _, sig in pairs], [voter for voter, _ in pairs])
+        else:
+            agg_sig = self.crypto.aggregate_signatures(
+                [sig for _, sig in pairs], [voter for voter, _ in pairs])
         qc = AggregatedVote(
             signature=AggregatedSignature(
                 agg_sig, build_bitmap(self.authorities, [v for v, _ in pairs])),
@@ -703,7 +747,7 @@ class Engine:
             if not (qc.vote_type == VoteType.PRECOMMIT
                     and qc.block_hash != NIL_HASH):
                 return
-        if not self._verify_qc(qc):
+        if not await self._verify_qc(qc):
             logger.warning("%s: bad QC", self._tag())
             return
         if qc.vote_type == VoteType.PREVOTE:
@@ -780,6 +824,8 @@ class Engine:
             return
         if c.round < self.round:
             return
+        if c.round - self.round > self.ROUND_WINDOW:
+            return  # outside the live-round window (memory bound)
         if not self._is_validator(sc.address):
             return
         chokes = self._chokes.setdefault(c.round, {})

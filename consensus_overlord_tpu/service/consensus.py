@@ -111,6 +111,7 @@ class Consensus:
         self.engine.stop()
 
     async def close(self) -> None:
+        self.frontier.close()  # release the dispatch worker thread
         await self.controller.close()
         await self.network.close()
 
@@ -145,12 +146,14 @@ class Consensus:
         logger.info("reconfigured to height %d (%d validators)",
                     configuration.height, len(configuration.validators))
 
-    def check_block(self, pwp: pb2.ProposalWithProof) -> bool:
+    async def check_block(self, pwp: pb2.ProposalWithProof) -> bool:
         """The public proof audit (reference src/consensus.rs:144-207):
         proof.block_hash must equal sm3(proposal.data) and proof.height the
         proposal height; the aggregated signature must verify over
         sm3(rlp(Vote{height, round, Precommit, block_hash})) for exactly
-        the voters named in the bitmap."""
+        the voters named in the bitmap.  The aggregate check runs through
+        the frontier's off-loop dispatch worker — a large-bitmap audit
+        never stalls the gRPC event loop on a device round-trip."""
         proposal_hash = sm3_hash(pwp.proposal.data)
         authority_list = self.brain.get_nodes()
         try:
@@ -171,7 +174,7 @@ class Consensus:
         vote = Vote(proof.height, proof.round, VoteType.PRECOMMIT,
                     proof.block_hash)
         vote_hash = sm3_hash(vote.encode())
-        ok = self.crypto.verify_aggregated_signature(
+        ok = await self.frontier.verify_aggregated(
             proof.signature.signature, vote_hash, voters)
         if not ok:
             logger.warning("check_block: aggregated signature failed")

@@ -50,7 +50,7 @@ class ConsensusServer:
         if self.consensus.reconfigure is None:
             logger.warning("check_block: server not ready")
             return pb2.StatusCode(code=Code.NOT_READY)
-        ok = self.consensus.check_block(request)
+        ok = await self.consensus.check_block(request)
         return pb2.StatusCode(
             code=Code.SUCCESS if ok else Code.PROPOSAL_CHECK_ERROR)
 

@@ -117,6 +117,8 @@ class SimNode:
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 self._task.cancel()
             self._task = None
+        if self.frontier is not None:
+            self.frontier.close()  # don't leak the dispatch worker thread
 
 
 class SimNetwork:

@@ -28,6 +28,11 @@ def main() -> None:
                         help="use the device-batched provider for the "
                         "chosen scheme (batches ship to the TPU once the "
                         "frontier coalesces past the provider threshold)")
+    parser.add_argument("--frontier", action="store_true",
+                        help="verify inbound signatures at the batching "
+                        "frontier (always on with --tpu: the device path "
+                        "needs coalesced batches + off-loop dispatch)")
+    parser.add_argument("--frontier-linger-ms", type=float, default=2.0)
     parser.add_argument("--timeout", type=float, default=120.0)
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args()
@@ -73,7 +78,9 @@ def main() -> None:
     async def run() -> dict:
         net = SimNetwork(n_validators=args.validators,
                          block_interval_ms=args.interval_ms,
-                         drop_rate=args.drop_rate, crypto_factory=factory)
+                         drop_rate=args.drop_rate, crypto_factory=factory,
+                         use_frontier=args.frontier or args.tpu,
+                         frontier_linger_s=args.frontier_linger_ms / 1000.0)
         net.start(init_height=1)
         t0 = time.perf_counter()
         last = t0
@@ -91,6 +98,17 @@ def main() -> None:
         def pct(q: float) -> float:
             return round(srt[min(len(srt) - 1, int(q * len(srt)))], 1)
 
+        stats = [n.frontier.stats for n in net.nodes
+                 if getattr(n, "frontier", None) is not None]
+        frontier = {}
+        if stats:
+            batches = sum(s.batches for s in stats)
+            frontier = {
+                "frontier_batches": batches,
+                "frontier_mean_batch": round(
+                    sum(s.requests for s in stats) / max(1, batches), 1),
+                "frontier_max_batch": max(s.max_batch for s in stats),
+            }
         return {
             "metric": "consensus-rounds",
             "validators": args.validators,
@@ -103,6 +121,7 @@ def main() -> None:
             "p95_ms": pct(0.95),
             "delivered": net.router.delivered,
             "dropped": net.router.dropped,
+            **frontier,
         }
 
     print(json.dumps(asyncio.run(run())))

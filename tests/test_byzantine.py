@@ -412,3 +412,48 @@ class TestRandomizedSchedules(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestRoundFloodMemory(unittest.TestCase):
+    def test_round_flood_memory_bounded(self):
+        """A single valid validator spraying votes/chokes across a huge
+        round range must not grow the per-round maps beyond the live
+        window (Engine.ROUND_WINDOW): memory stays O(window), not
+        O(rounds sprayed)."""
+        from consensus_overlord_tpu.core.types import Choke, SignedChoke
+
+        async def main():
+            h = EngineHarness()
+            await h.start(1)
+            eng = h.engine
+            height = eng.height
+            attacker = h.cryptos[1]
+            window = eng.ROUND_WINDOW
+
+            # Chokes: rounds 0..199 (only ≤ window accepted) plus a spray
+            # of far-future rounds (all rejected).
+            for r in list(range(200)) + [10**6 + i for i in range(50)]:
+                c = Choke(height, r)
+                sig = attacker.sign(sm3_hash(c.encode()))
+                eng.handler.send_msg(SignedChoke(sig, attacker.pub_key, c))
+            # Votes: every round the engine leads in 0..199 plus far spray.
+            for r in list(range(200)) + [10**6 + i for i in range(50)]:
+                if eng.leader(height, r) != eng.name:
+                    continue
+                eng.handler.send_msg(h.signed_vote(
+                    attacker, height, r, VoteType.PREVOTE,
+                    h.adapter.block_hash))
+            await h.settle(0.5)
+
+            cur = eng.round
+            assert len(eng._chokes) <= 2 * window + 2, len(eng._chokes)
+            assert all(r <= cur + window for r in eng._chokes)
+            assert len(eng._prevotes) <= 2 * window + 2, len(eng._prevotes)
+            assert all(abs(r - cur) <= window for r in eng._prevotes)
+            # Sanity: in-window messages were NOT dropped — the guard
+            # bounds memory without breaking collection.
+            assert eng._chokes, "in-window chokes should be collected"
+            assert eng._prevotes, "in-window votes should be collected"
+            await h.stop()
+
+        run(main())

@@ -280,3 +280,70 @@ class TestBlsEndToEnd:
             assert net.nodes[0].crypto.verify_aggregated_signature(
                 proof.signature.signature, sm3_hash(vote.encode()), voters)
         run(main(), timeout=180)
+
+
+class TestAuthorityRefreshOnRecovery:
+    def test_wal_ahead_of_init_refreshes_authorities(self):
+        """A WAL recovered to a height past init_height refreshes the
+        authority set through the chain port (the reference engine's
+        get_authority_list callback, src/consensus.rs:659-666) — the
+        caller's list describes init_height and may predate a
+        reconfiguration."""
+        async def main():
+            from consensus_overlord_tpu.core.types import validators_to_nodes
+            from consensus_overlord_tpu.engine.smr import Engine
+
+            cryptos = [Ed25519Crypto(bytes([i]) * 32) for i in range(1, 6)]
+            old = validators_to_nodes([c.pub_key for c in cryptos[:4]])
+            new = validators_to_nodes([c.pub_key for c in cryptos[1:]])
+            asked = []
+
+            class StubAdapter:
+                async def get_block(self, height):
+                    raise RuntimeError("no proposal")
+
+                async def check_block(self, height, block_hash, content):
+                    return True
+
+                async def commit(self, height, commit):
+                    return None
+
+                async def get_authority_list(self, height):
+                    asked.append(height)
+                    return new
+
+                async def broadcast_to_other(self, msg_type, payload):
+                    pass
+
+                async def transmit_to_relayer(self, relayer, msg_type,
+                                              payload):
+                    pass
+
+                def report_error(self, context):
+                    pass
+
+                def report_view_change(self, height, round, reason):
+                    pass
+
+            # First life at height 7 writes a WAL.
+            wal = MemoryWal()
+            eng = Engine(cryptos[0].pub_key, StubAdapter(), cryptos[0], wal)
+            task = asyncio.get_running_loop().create_task(
+                eng.run(7, 20, old))
+            await asyncio.sleep(0.05)
+            eng.stop()
+            await task
+
+            # Second life starts at init 5 with the OLD list; WAL says 7.
+            eng2 = Engine(cryptos[0].pub_key, StubAdapter(), cryptos[0],
+                          wal)
+            task2 = asyncio.get_running_loop().create_task(
+                eng2.run(5, 20, old))
+            await asyncio.sleep(0.05)
+            assert asked and asked[0] == 7
+            assert eng2.authorities == sorted(
+                new, key=lambda n: n.address)
+            eng2.stop()
+            await task2
+
+        run(main())
