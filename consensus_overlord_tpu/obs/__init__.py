@@ -8,17 +8,25 @@ src/main.rs:96, 111, 137).  Here:
   metrics.py — per-RPC latency histograms (the MiddlewareLayer analog) +
                a Prometheus exporter on `metrics_port`
   logctx.py  — logging init from LogConfig + W3C traceparent extraction
-               from gRPC metadata into a contextvar, stamped onto every
-               log record (the `set_parent` analog)
+               from gRPC metadata into contextvars, stamped onto every
+               log record (the `set_parent` analog); per-request server
+               spans when an exporter is attached
+  tracing.py — Jaeger-agent span export (thrift compact over UDP,
+               dependency-free), honoring log_config.agent_endpoint
 """
 
-from .logctx import init_logging, trace_context, TraceContextInterceptor
+from .logctx import (init_logging, span_context, trace_context,
+                     TraceContextInterceptor)
 from .metrics import Metrics, MetricsInterceptor
+from .tracing import JaegerExporter, Span
 
 __all__ = [
+    "JaegerExporter",
     "Metrics",
     "MetricsInterceptor",
+    "Span",
     "TraceContextInterceptor",
     "init_logging",
+    "span_context",
     "trace_context",
 ]

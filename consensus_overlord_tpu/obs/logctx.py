@@ -24,9 +24,11 @@ import grpc
 #: current request's trace id ("-" outside any traced request)
 trace_context: contextvars.ContextVar[str] = contextvars.ContextVar(
     "trace_context", default="-")
-
-_TRACEPARENT_RE = re.compile(
-    r"^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$")
+#: current request's span id (hex16; "" outside any traced request).
+#: Outbound gRPC calls use it as the parent when injecting traceparent
+#: (service/rpc.py RetryClient.call).
+span_context: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "span_context", default="")
 
 _FORMAT = ("%(asctime)s %(levelname)-5s %(name)s "
            "[trace=%(trace_id)s] %(message)s")
@@ -57,29 +59,62 @@ def init_logging(log_config=None, service_name: str = "consensus") -> None:
     root.handlers = handlers
 
 
+_TRACEPARENT_FULL_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
 class TraceContextInterceptor(grpc.aio.ServerInterceptor):
-    """Extract `traceparent` from request metadata into the contextvar —
-    the set_parent analog (reference src/main.rs:96, 111, 137)."""
+    """Extract `traceparent` from request metadata into the contextvars —
+    the set_parent analog (reference src/main.rs:96, 111, 137) — and,
+    when a span exporter is configured (log_config.agent_endpoint,
+    reference src/main.rs:173-175), record one server span per request
+    with the inbound span as parent."""
+
+    def __init__(self, exporter=None):
+        #: obs.tracing.JaegerExporter (or None: context-only, no export)
+        self._exporter = exporter
 
     async def intercept_service(self, continuation, handler_call_details):
         trace_id: Optional[str] = None
+        parent_span: int = 0
         for key, value in handler_call_details.invocation_metadata or ():
             if key == "traceparent" and isinstance(value, str):
-                m = _TRACEPARENT_RE.match(value)
+                m = _TRACEPARENT_FULL_RE.match(value)
                 if m:
                     trace_id = m.group(1)
+                    parent_span = int(m.group(2), 16)
         handler = await continuation(handler_call_details)
-        if handler is None or handler.unary_unary is None or trace_id is None:
+        if handler is None or handler.unary_unary is None:
             return handler
+        if trace_id is None and self._exporter is None:
+            return handler  # nothing to propagate, nothing to record
         inner = handler.unary_unary
-        tid = trace_id
+        exporter = self._exporter
+        operation = getattr(handler_call_details, "method", "") or "rpc"
+
+        from .tracing import Span, new_span_id, new_trace_id
+
+        tid = trace_id if trace_id is not None else f"{new_trace_id():032x}"
+        pspan = parent_span
 
         async def with_ctx(request, context):
-            token = trace_context.set(tid)
+            import time as _time
+
+            span_id = new_span_id()
+            t_token = trace_context.set(tid)
+            s_token = span_context.set(f"{span_id:016x}")
+            start = _time.time()
             try:
                 return await inner(request, context)
             finally:
-                trace_context.reset(token)
+                if exporter is not None:
+                    exporter.report(Span(
+                        trace_id=int(tid, 16), span_id=span_id,
+                        parent_span_id=pspan, operation=operation,
+                        start_us=int(start * 1e6),
+                        duration_us=int((_time.time() - start) * 1e6)))
+                span_context.reset(s_token)
+                trace_context.reset(t_token)
 
         return grpc.unary_unary_rpc_method_handler(
             with_ctx,

@@ -35,7 +35,8 @@ from typing import Optional
 
 from .. import __version__
 from ..crypto.provider import load_private_key
-from ..obs import Metrics, TraceContextInterceptor, init_logging
+from ..obs import (JaegerExporter, Metrics, TraceContextInterceptor,
+                   init_logging)
 from .config import ConsensusConfig
 from .consensus import Consensus
 from .rpc import Code
@@ -54,6 +55,13 @@ class ServiceRuntime:
         self._host = host
         self.metrics = (Metrics(config.metrics_buckets)
                         if config.enable_metrics else None)
+        # Jaeger span export when the config names an agent (reference
+        # src/main.rs:173-175, example/config.toml:14); spans still get
+        # context-propagated without it.
+        lc = config.log_config
+        self.tracer = (JaegerExporter(lc.agent_endpoint,
+                                      lc.service_name or "consensus")
+                       if lc is not None and lc.agent_endpoint else None)
         self.consensus: Optional[Consensus] = None
         self.bound_port: Optional[int] = None
         self.metrics_port: Optional[int] = None
@@ -65,7 +73,7 @@ class ServiceRuntime:
         """Bring the service up; returns the bound consensus port."""
         cfg = self.config
         self.consensus = Consensus(cfg, self._private_key)
-        interceptors = [TraceContextInterceptor()]
+        interceptors = [TraceContextInterceptor(exporter=self.tracer)]
         if self.metrics is not None:
             interceptors.append(self.metrics.interceptor())
         self._server, self.bound_port = build_server(
@@ -127,6 +135,8 @@ class ServiceRuntime:
             await self.consensus.close()
         if self.metrics is not None:
             self.metrics.stop_exporter()
+        if self.tracer is not None:
+            self.tracer.close()
         self._stopped.set()
 
     async def wait_stopped(self) -> None:

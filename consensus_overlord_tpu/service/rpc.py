@@ -94,10 +94,21 @@ class RetryClient:
                 response_deserializer=resp_cls.FromString)
 
     async def call(self, method: str, request, timeout: float = 10.0):
+        # Propagate the current trace over the wire (the reference's
+        # cloud_util::tracer propagation, src/main.rs:96): the active
+        # request's trace id + span id become the outbound traceparent,
+        # so cross-service traces survive the hop.
+        from ..obs.logctx import span_context, trace_context
+        metadata = None
+        tid = trace_context.get()
+        if tid != "-":
+            span = span_context.get() or "0" * 16
+            metadata = (("traceparent", f"00-{tid}-{span}-01"),)
         last_exc: Optional[Exception] = None
         for attempt in range(self._retries):
             try:
-                return await self._calls[method](request, timeout=timeout)
+                return await self._calls[method](request, timeout=timeout,
+                                                 metadata=metadata)
             except grpc.aio.AioRpcError as e:  # transient transport errors
                 last_exc = e
                 if attempt + 1 < self._retries:

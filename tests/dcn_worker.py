@@ -1,0 +1,106 @@
+"""Worker process for the two-process DCN smoke test (test_multihost.py).
+
+Invoked as: python dcn_worker.py <process_id> <num_processes> <coordinator>
+
+Each process brings 2 virtual CPU devices into a jax.distributed runtime
+(the DCN analog this environment can execute), builds the host-major
+global mesh, and runs the PRODUCTION sharded verify-round kernel over a
+batch that spans both processes' devices — asserting the replicated MSM
+aggregates against the host oracle.  Prints "DCN-OK" on success.
+"""
+
+import os
+import sys
+
+PID = int(sys.argv[1])
+NPROC = int(sys.argv[2])
+COORD = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from consensus_overlord_tpu.compile_cache import enable  # noqa: E402
+
+enable()
+
+# Join the distributed runtime BEFORE anything touches the XLA backend —
+# the ops modules build jnp constants at import time, which would
+# initialize a single-process backend and make jax.distributed refuse.
+from consensus_overlord_tpu.parallel.multihost import (  # noqa: E402
+    global_mesh, init_multihost)
+
+_JOINED = init_multihost(COORD, NPROC, PID)
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from consensus_overlord_tpu.core.sm3 import sm3_hash  # noqa: E402
+from consensus_overlord_tpu.crypto import bls12381 as oracle  # noqa: E402
+from consensus_overlord_tpu.ops import bls12381_groups as dev  # noqa: E402
+from consensus_overlord_tpu.parallel import (  # noqa: E402
+    sharded_verify_round)
+
+
+def main() -> None:
+    assert _JOINED, "coordinator join failed"
+    assert jax.process_count() == NPROC, jax.process_count()
+    mesh = global_mesh()
+    assert mesh.devices.size == 2 * NPROC, mesh.devices.size
+
+    # Deterministic batch (both processes build identical host data).
+    batch = 8
+    h = sm3_hash(b"dcn-smoke-block")
+    sks = [9000 + 17 * i for i in range(batch)]
+    sigs = [oracle.sign(sk, h) for sk in sks]
+    pks_aff = [oracle.g2_decompress(oracle.sk_to_pk(sk)) for sk in sks]
+    parsed = dev.parse_g1_compressed(sigs)
+    scalars = [(0x9E3779B9 * (i + 1)) | (1 << 63) for i in range(batch)]
+    wpacked = np.frombuffer(
+        b"".join(s.to_bytes(8, "big") for s in scalars),
+        np.uint8).reshape(batch, 8).copy()
+    rows = np.arange(batch, dtype=np.int64)
+    pk = dev.g2_from_oracle(pks_aff)
+    pkx, pky, pkz = (np.asarray(pk.x), np.asarray(pk.y), np.asarray(pk.z))
+
+    shard = NamedSharding(mesh, P("lanes"))
+    repl = NamedSharding(mesh, P())
+
+    def dist(arr, sharding):
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
+    fn = sharded_verify_round(mesh)
+    out = fn(dist(parsed.x, shard), dist(parsed.sign, shard),
+             dist(parsed.infinity, shard), dist(parsed.wellformed, shard),
+             dist(wpacked, shard), dist(rows, shard),
+             dist(pkx, repl), dist(pky, repl), dist(pkz, repl))
+    ax, ay, ainf, valid, gx, gy, ginf = out
+    # Replicated outputs are process-local readable; `valid` is sharded —
+    # check this process's addressable shards only.
+    for s in valid.addressable_shards:
+        assert bool(np.asarray(s.data).all()), "invalid lane in local shard"
+    got_g1 = (dev.FQ.ints_from_strict(np.asarray(ax))[0],
+              dev.FQ.ints_from_strict(np.asarray(ay))[0])
+    want = None
+    for s, r in zip(sigs, scalars):
+        want = oracle.g1_add(want, oracle.g1_mul(oracle.g1_decompress(s), r))
+    assert got_g1 == want, "G1 RLC aggregate disagrees with oracle over DCN"
+    want2 = None
+    for p, r in zip(pks_aff, scalars):
+        want2 = oracle.g2_add(want2, oracle.g2_mul(p, r))
+    got_g2 = (tuple(dev.FQ.ints_from_strict(np.asarray(gx))),
+              tuple(dev.FQ.ints_from_strict(np.asarray(gy))))
+    assert got_g2 == want2, "G2 RLC aggregate disagrees with oracle over DCN"
+    print("DCN-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

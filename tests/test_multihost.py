@@ -1,9 +1,13 @@
-"""Multi-host (DCN) init: single-process degenerate path + global mesh.
+"""Multi-host (DCN) init: single-process degenerate path, global mesh,
+and a REAL two-process coordinator run.
 
-The real multi-process path needs a coordinator across machines; the CI
-environment has one host, so these tests pin the contract the launcher
-relies on: no-coordinator → clean single-process fallback, and the
-global mesh spans every (virtual) device in jax.devices() order."""
+The fast tests pin the contract the launcher relies on (no-coordinator →
+clean single-process fallback; the global mesh spans every (virtual)
+device in jax.devices() order).  The slow test actually spawns two OS
+processes that join one jax.distributed runtime over a local coordinator
+— the DCN handshake a single process can never cover."""
+
+import os
 
 import numpy as np
 import pytest
@@ -32,3 +36,45 @@ def test_global_mesh_spans_all_devices():
 def test_global_mesh_matches_make_mesh_shape():
     m1, m2 = global_mesh(), make_mesh()
     assert m1.devices.size == m2.devices.size
+
+
+@pytest.mark.slow
+def test_two_process_dcn_verify_round():
+    """Two OS processes × 2 virtual CPU devices join one
+    jax.distributed runtime (the DCN analog executable here), build the
+    host-major global mesh, and run the production sharded verify-round
+    kernel over a batch spanning both processes — each asserting the
+    replicated MSM aggregates against the host oracle
+    (tests/dcn_worker.py).  Exercises the real multi-process
+    coordinator path that single-process tests cannot."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__), "dcn_worker.py")
+    # Strip the TPU-relay plugin trigger too: its sitecustomize hook
+    # initializes a PJRT backend at interpreter startup, which
+    # jax.distributed.initialize must precede.
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "PALLAS_AXON_POOL_IPS")}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "2", coord],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=1800)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+        assert "DCN-OK" in out
