@@ -14,9 +14,10 @@ aggregations per QC check — is batched across TPU lanes:
   relation
       e(Σ r_i·S_i, −g2) · Π_g e(H_g, Σ_{i∈g} r_i·P_i) == 1
   The multi-scalar-multiplications (the O(N) part) run on device as
-  digit-plane MSMs (ops/curve.py msm_bits — the SIMD shape of
-  Pippenger's bucket method); the pairings (O(1 + #distinct hashes))
-  run on the host native backend.  A failed batch falls back to
+  uniform windowed-ladder scans + tree reductions (ops/curve.py
+  msm_bits — the formulation measured fastest on TPU; see the negative
+  Pippenger result in its docstring); the pairings (O(1 + #distinct
+  hashes)) run on the host native backend.  A failed batch falls back to
   per-signature verification, so results are exact, not probabilistic.
 
 * ``aggregate_signatures`` / ``verify_aggregated_signature``: the QC
@@ -96,7 +97,7 @@ def _pk_capacity(n: int) -> int:
 def verify_round_fn(x, sign, inf, ok, wpacked, rows, pkx, pky, pkz):
     """The fused single-dispatch consensus-round verification step — the
     flagship forward step.  One jit covers: weight unpack, G1 decompress
-    + validate + per-lane fast subgroup check, the G1 digit-plane MSM
+    + validate + per-lane fast subgroup check, the G1 MSM
     Σ r_i·S_i, the pubkey-cache gather, and the G2 MSM Σ r_i·P_i with
     weights masked by the device-computed validity so both sides of the
     pairing relation see the same lane set.  Returns strict

@@ -259,64 +259,23 @@ class CurveOps:
 
     def msm_bits(self, p: Point, bits: Array) -> Point:
         """Σᵢ kᵢ·pᵢ over the leading batch axis with per-lane scalars as
-        an MSB-first bit array (B, nbits) — the fused, fast form of
-        ``tree_sum(scalar_mul_bits(p, bits))``.
+        an MSB-first bit array (B, nbits): the windowed-ladder scan +
+        one tree reduction, returned as a leading-axis-1 point.
 
-        Digit-plane decomposition:  Σᵢ kᵢpᵢ = Σ_w 16^w · Σᵢ d_{i,w}·pᵢ
-        with a signed base-16 recode (digits in [−8, 8], table 0..8·pᵢ,
-        negative digits are y-flips at lookup).  Per window the inner sum
-        is ONE one-hot table lookup per lane plus one batched tree
-        reduction; the 16^w weighting collapses to a width-1 Horner scan
-        (4 doublings + 1 add per window on a single point).  Point-op
-        count per lane: ~7 table + W lookups + W tree adds (W = nbits/4
-        + 1) ≈ 24 for 64-bit scalars — vs ~95 for the windowed ladder +
-        tree, whose per-lane doubling runs dominate.  This is the
-        TPU-native shape of Pippenger's bucket MSM: buckets would need
-        data-dependent scatters, digit planes need only selects and a
-        tree — same asymptotic win, SIMD-friendly.
-
-        Returns a leading-axis-1 point (same contract as tree_sum)."""
-        nbits = bits.shape[-1]
-        assert nbits % 4 == 0 and bits.ndim == p.x.ndim - self._coord_rank() \
-            + 1, "bits must be (batch, nbits) over a 1-D point batch"
-        w0 = nbits // 4
-        weights = jnp.asarray([8, 4, 2, 1], jnp.int32)
-        vals = (bits.reshape(bits.shape[:-1] + (w0, 4)) * weights).sum(-1)
-        vals_lsb = jnp.moveaxis(jnp.flip(vals, axis=-1), -1, 0)  # (w0, B)
-
-        def recode(carry, v):
-            t = v + carry
-            over = t > 8
-            return over.astype(jnp.int32), jnp.where(over, t - 16, t)
-
-        carry, digs = lax.scan(
-            recode, jnp.zeros(bits.shape[:-1], jnp.int32), vals_lsb)
-        digs = jnp.concatenate([digs, carry[None]], axis=0)  # (W, B) LSB-1st
-
-        table = self._signed_table(p)  # (9, B) points
-        planes = []
-        for w in range(w0 + 1):
-            s = self._table_lookup(table, jnp.abs(digs[w]))
-            s = Point(s.x, self.f.where(digs[w] < 0, self.f.neg(s.y), s.y),
-                      s.z)
-            planes.append(s)
-        # (B, W) points: batch leading so tree_sum reduces lanes and
-        # carries the window axis along.
-        sp = Point(jnp.stack([s.x for s in planes], axis=1),
-                   jnp.stack([s.y for s in planes], axis=1),
-                   jnp.stack([s.z for s in planes], axis=1))
-        red = self.tree_sum(sp)               # (1, W) point
-        sw = Point(red.x[0], red.y[0], red.z[0])  # (W,) LSB-first
-
-        def horner(acc, s):
-            for _ in range(4):
-                acc = self.dbl(acc)
-            return self.add(acc, s), None
-
-        acc, _ = lax.scan(
-            horner, self.infinity_like(sw.x[0]),
-            Point(jnp.flip(sw.x, 0), jnp.flip(sw.y, 0), jnp.flip(sw.z, 0)))
-        return Point(acc.x[None], acc.y[None], acc.z[None])
+        MEASURED NEGATIVE RESULT (kept so it isn't re-tried blindly): a
+        Pippenger-style digit-plane decomposition — signed base-16
+        recode, per-window table lookups, one batched tree per window,
+        width-1 Horner combine — cuts nominal point-ops/lane ~4x (24 vs
+        ~95) but ran 2.1x SLOWER on TPU v5e at B=8192 (G2: ~660 ms vs
+        ~305 ms, identical outputs; scripts/bench_msm_ab.py, 2026-07
+        ledger in BASELINE.md).  The uniform lax.scan ladder keeps every
+        step a full-width field-op group, which is what the VPU + XLA
+        pipeline reward; the digit planes trade those for gather/select
+        traffic and wide irregular reductions that don't pay for their
+        saved MACs at the current field-op efficiency.  The lever that
+        IS real: the dedicated a=0 doubling inside the scan (~25% fewer
+        field muls per step than doubling-by-add)."""
+        return self.tree_sum(self.scalar_mul_bits(p, bits))
 
     # -- reductions ----------------------------------------------------------
 

@@ -1,0 +1,142 @@
+"""A/B the MSM formulations on the real device — the experiment behind
+the r3 ledger entry in BASELINE.md ("digit-plane MSM measured 2.1x
+slower than the windowed ladder and reverted").
+
+The digit-plane (Pippenger-style) formulation lives HERE, not in
+production code: ops/curve.py msm_bits is the ladder+tree form the
+measurement selected.  Keeping the loser reproducible stops it being
+re-tried blindly.
+
+Measurement honesty (see BASELINE.md r3 ledger): the remote PJRT relay
+dedupes repeated identical computations and block_until_ready is not a
+reliable barrier through it — so every timed iteration here draws FRESH
+random scalars and synchronizes via jax.device_get of a strict affine
+output.  Identical result digests across formulations double as a
+correctness cross-check.
+
+Usage: python scripts/bench_msm_ab.py [N]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+
+
+def digit_plane_msm(curve, p, bits):
+    """Σᵢ kᵢ·pᵢ via signed base-16 digit planes: recode, one gathered
+    table lookup per window, one batched tree reduction per window
+    (window axis rides along the lane tree), width-1 Horner combine.
+    ~4x fewer nominal point-ops/lane than the ladder — and measured
+    2.1x slower on TPU v5e, which is why production msm_bits is the
+    ladder."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from consensus_overlord_tpu.ops.curve import Point
+
+    nbits = bits.shape[-1]
+    w0 = nbits // 4
+    weights = jnp.asarray([8, 4, 2, 1], jnp.int32)
+    vals = (bits.reshape(bits.shape[:-1] + (w0, 4)) * weights).sum(-1)
+    vals_lsb = jnp.moveaxis(jnp.flip(vals, axis=-1), -1, 0)  # (w0, B)
+
+    def recode(carry, v):
+        t = v + carry
+        over = t > 8
+        return over.astype(jnp.int32), jnp.where(over, t - 16, t)
+
+    carry, digs = lax.scan(
+        recode, jnp.zeros(bits.shape[:-1], jnp.int32), vals_lsb)
+    digs = jnp.concatenate([digs, carry[None]], axis=0)  # (W, B) LSB-first
+
+    table = curve._signed_table(p)  # (9, B) points
+    absd = jnp.abs(digs)
+    lanes = jnp.arange(digs.shape[1])[None, :]
+    sx = table.x[absd, lanes]  # (W, B, coord)
+    sy = curve.f.where(digs < 0, curve.f.neg(table.y[absd, lanes]),
+                       table.y[absd, lanes])
+    sz = table.z[absd, lanes]
+    sp = Point(jnp.moveaxis(sx, 0, 1), jnp.moveaxis(sy, 0, 1),
+               jnp.moveaxis(sz, 0, 1))  # (B, W)
+    red = curve.tree_sum(sp)  # (1, W)
+    sw = Point(red.x[0], red.y[0], red.z[0])  # (W,) LSB-first
+
+    def horner(acc, s):
+        for _ in range(4):
+            acc = curve.dbl(acc)
+        return curve.add(acc, s), None
+
+    acc, _ = lax.scan(
+        horner, curve.infinity_like(sw.x[0]),
+        Point(jnp.flip(sw.x, 0), jnp.flip(sw.y, 0), jnp.flip(sw.z, 0)))
+    return Point(acc.x[None], acc.y[None], acc.z[None])
+
+
+def time_honest(label, fn, fresh_bits, iters=3):
+    """Fresh inputs per iteration + device_get barrier; prints per-run
+    ms and the result digest (must match across formulations)."""
+    jax.device_get(fn(fresh_bits()))  # warm/compile
+    best = None
+    for _ in range(iters):
+        bits = fresh_bits()
+        jax.block_until_ready(bits)
+        t0 = time.perf_counter()
+        out = jax.device_get(fn(bits))
+        dt = (time.perf_counter() - t0) * 1e3
+        best = dt if best is None or dt < best else best
+        print(f"{label:16s} {dt:9.2f} ms  digest={int(np.asarray(out).sum())}",
+              flush=True)
+    return best
+
+
+def main():
+    from consensus_overlord_tpu.compile_cache import enable
+    enable()
+    import jax.numpy as jnp
+
+    from consensus_overlord_tpu.ops import bls12381_groups as dev
+
+    print(f"device: {jax.devices()[0].platform}  N={N}", flush=True)
+    import bench
+    bench.N = N
+    sigs, h, pks = bench._fixture()
+
+    rng = np.random.default_rng(7)
+
+    def fresh_bits():
+        return jnp.asarray(rng.integers(0, 2, (N, 64), dtype=np.int32))
+
+    pk_parsed = dev.parse_g2_compressed(pks)
+    g2pt, _ = jax.jit(dev.g2_decompress_device)(
+        jnp.asarray(pk_parsed.x), jnp.asarray(pk_parsed.sign),
+        jnp.asarray(pk_parsed.infinity), jnp.asarray(pk_parsed.wellformed))
+    g2pt = jax.block_until_ready(g2pt)
+    parsed = dev.parse_g1_compressed(sigs)
+    g1pt, _ = jax.jit(dev.g1_decompress_device)(
+        jnp.asarray(parsed.x), jnp.asarray(parsed.sign),
+        jnp.asarray(parsed.infinity), jnp.asarray(parsed.wellformed))
+    g1pt = jax.block_until_ready(g1pt)
+
+    def strict_x(curve, p):
+        return dev.FQ.strict(curve.to_affine(p)[0][0])
+
+    for name, curve, pt in (("g1", dev.G1, g1pt), ("g2", dev.G2, g2pt)):
+        ladder = jax.jit(lambda b, c=curve, p=pt: strict_x(
+            c, c.msm_bits(p, b)))
+        planes = jax.jit(lambda b, c=curve, p=pt: strict_x(
+            c, digit_plane_msm(c, p, b)))
+        t_l = time_honest(f"{name}_ladder", ladder, fresh_bits)
+        t_p = time_honest(f"{name}_digitplane", planes, fresh_bits)
+        print(f"{name}: digit-plane / ladder = {t_p / t_l:.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -210,11 +210,10 @@ class TestEndomorphismSubgroupChecks:
 
 
 class TestMsmBits:
-    """msm_bits (the digit-plane MSM under the RLC batch verification)
-    must agree bit-for-bit with tree_sum(scalar_mul_bits(...)) and the
-    oracle's linear combination for every scalar shape the provider
-    generates (64-bit weights, zero-weight padding lanes, infinity
-    lanes)."""
+    """msm_bits (the MSM under the RLC batch verification) must agree
+    bit-for-bit with tree_sum(scalar_mul_bits(...)) and the oracle's
+    linear combination for every scalar shape the provider generates
+    (64-bit weights, zero-weight padding lanes, infinity lanes)."""
 
     def _scalars(self):
         ks = [RNG.randrange(2**64) for _ in range(8)]
