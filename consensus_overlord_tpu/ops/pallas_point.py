@@ -1,0 +1,205 @@
+"""Fused Pallas TPU kernels for whole G1 group operations.
+
+ops/pallas_field.py fuses ONE field multiply per kernel and measured
+~1.0x XLA — single muls are already scheduled well.  The hypothesis this
+module tests: the loss is at fusion BOUNDARIES.  A G1 complete add is 12
+field muls plus ~20 add/sub/small-multiple reductions; under XLA each
+mul's fold contraction breaks elementwise fusion, so intermediates
+round-trip through HBM ~30 times per point-add.  Here the ENTIRE point
+operation (Renes–Costello–Batina complete add, or the dedicated a=0
+doubling) runs in one Mosaic kernel: limbs on sublanes, batch on lanes,
+every intermediate resident in VMEM/registers.
+
+The in-kernel field helpers replay FieldSpec's statically planned
+reduction pipelines (same bounds proofs, same step lists — see
+ops/field.py), so outputs are bit-identical to the XLA path; the
+correctness tests in tests/test_pallas_point.py pin that on the CPU
+interpreter, and scripts/bench_pallas_point.py measures the chain
+throughput on hardware.
+
+Layout: coordinates are (n, B) transposed blocks (B a multiple of the
+128-lane tile).  Chains of point ops stay in this layout; transposes
+happen once at the chain boundary.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .field import FieldSpec
+from .pallas_field import _use_interpret
+
+
+def _plans(spec: FieldSpec):
+    """The static reduction plans a point op needs, precomputed once."""
+    L = spec.loose_max
+    pad_max = int(spec._pad_np.max())
+    return {
+        "mul": spec._plan(list(spec._conv_bounds())),
+        "add2": spec._plan([2 * L] * spec.n),
+        "add3": spec._plan([3 * L] * spec.n),
+        "sub": spec._plan([L + pad_max] * spec.n),
+        "neg": spec._plan([pad_max] * spec.n),
+        "small8": spec._plan([8 * L] * spec.n),
+        "small12": spec._plan([12 * L] * spec.n),
+        "small3": spec._plan([3 * L] * spec.n),
+        "small2": spec._plan([2 * L] * spec.n),
+    }
+
+
+def _field_ops(spec: FieldSpec, plans, fold, pad_col):
+    """In-kernel field helpers over (n, BT) register arrays.  `fold` is
+    the loaded fold-row constant array (rows, n); `pad_col` the loaded
+    subtraction-pad limb column (n, 1)."""
+    n, b_bits, mask = spec.n, spec.b, spec.mask
+    pad_row = pad_col
+
+    def reduce(v, plan):
+        for step, arg in plan:
+            if step == "pad":
+                v = jnp.concatenate(
+                    [v, jnp.zeros((arg, v.shape[1]), jnp.int32)], axis=0)
+            elif step == "fold":
+                lo, hi = v[:n], v[n:]
+                acc = lo
+                for r in range(arg):
+                    acc = acc + fold[r, :][:, None] * hi[r, :][None, :]
+                v = acc
+            else:  # carry
+                if arg:
+                    v = jnp.concatenate(
+                        [v, jnp.zeros((1, v.shape[1]), jnp.int32)], axis=0)
+                c = v >> b_bits
+                v = (v & mask) + jnp.concatenate(
+                    [jnp.zeros((1, v.shape[1]), jnp.int32), c[:-1]], axis=0)
+        return v
+
+    def mul(x, y):
+        wide = None
+        for i in range(n):
+            term = jnp.pad(x[i, :][None, :] * y, ((i, n - 1 - i), (0, 0)))
+            wide = term if wide is None else wide + term
+        return reduce(wide, plans["mul"])
+
+    def add(x, y):
+        return reduce(x + y, plans["add2"])
+
+    def sub(x, y):
+        return reduce(x + (pad_row - y), plans["sub"])
+
+    def mul_small(x, k, plan_key):
+        return reduce(x * k, plans[plan_key])
+
+    return mul, add, sub, mul_small
+
+
+def _g1_add_body(spec: FieldSpec, plans, b3: int):
+    """The RCB complete-addition straight line (a=0) as in-kernel code —
+    mirrors ops/curve.py CurveOps.add exactly."""
+
+    def body(f, p1, p2):
+        mul, add, sub, mul_small = f
+        x1, y1, z1 = p1
+        x2, y2, z2 = p2
+        t0 = mul(x1, x2)
+        t1 = mul(y1, y2)
+        t2 = mul(z1, z2)
+        t3 = sub(mul(add(x1, y1), add(x2, y2)), add(t0, t1))
+        t4 = sub(mul(add(y1, z1), add(y2, z2)), add(t1, t2))
+        t5 = sub(mul(add(x1, z1), add(x2, z2)), add(t0, t2))
+        three_t0 = mul_small(t0, 3, "small3")
+        b3_t2 = mul_small(t2, b3, "small12")
+        z3 = add(t1, b3_t2)
+        t1n = sub(t1, b3_t2)
+        y3 = mul_small(t5, b3, "small12")
+        x3 = sub(mul(t3, t1n), mul(t4, y3))
+        y3 = add(mul(t1n, z3), mul(y3, three_t0))
+        z3 = add(mul(z3, t4), mul(three_t0, t3))
+        return x3, y3, z3
+
+    return body
+
+
+def _g1_dbl_body(spec: FieldSpec, plans, b3: int):
+    """Dedicated a=0 doubling (RCB Alg 9) — mirrors CurveOps.dbl."""
+
+    def body(f, p):
+        mul, add, sub, mul_small = f
+        x, y, z = p
+        t0 = mul(y, y)
+        z3 = mul_small(t0, 8, "small8")
+        t1 = mul(y, z)
+        t2 = mul_small(mul(z, z), b3, "small12")
+        x3 = mul(t2, z3)
+        y3 = add(t0, t2)
+        z3 = mul(t1, z3)
+        t0 = sub(t0, mul_small(t2, 3, "small3"))
+        y3 = add(mul(t0, y3), x3)
+        x3 = mul_small(mul(t0, mul(x, y)), 2, "small2")
+        return x3, y3, z3
+
+    return body
+
+
+@functools.lru_cache(maxsize=None)
+def _point_kernel(spec: FieldSpec, op: str, block_b: int, b3: int):
+    """pallas_call for one fused point op on (n, block_b) tiles.
+    op: 'add' (6 coord inputs) or 'dbl' (3 coord inputs)."""
+    from jax.experimental import pallas as pl
+
+    n = spec.n
+    plans = _plans(spec)
+    fold_np = spec._fold_np
+    n_rows = fold_np.shape[0]
+    n_in = 6 if op == "add" else 3
+    body = (_g1_add_body if op == "add" else _g1_dbl_body)(spec, plans, b3)
+
+    def kernel(*refs):
+        coord_refs, fold_ref, pad_ref = refs[:n_in], refs[n_in], refs[n_in + 1]
+        out_refs = refs[n_in + 2:]
+        f = _field_ops(spec, plans, fold_ref[:], pad_ref[:])
+        coords = [r[:] for r in coord_refs]
+        if op == "add":
+            outs = body(f, tuple(coords[:3]), tuple(coords[3:]))
+        else:
+            outs = body(f, tuple(coords))
+        for r, v in zip(out_refs, outs):
+            r[:] = v
+
+    fold_in = jnp.asarray(fold_np, jnp.int32)
+    pad_in = jnp.asarray(spec._pad_np, jnp.int32)[:, None]  # (n, 1)
+    spec_c = pl.BlockSpec((n, block_b), lambda i: (0, i))
+
+    def call(*coordsT):
+        batch = coordsT[0].shape[1]
+        assert batch % block_b == 0
+        grid = (batch // block_b,)
+        outs = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[spec_c] * n_in + [
+                pl.BlockSpec((n_rows, n), lambda i: (0, 0)),
+                pl.BlockSpec((n, 1), lambda i: (0, 0))],
+            out_specs=[spec_c] * 3,
+            out_shape=[jax.ShapeDtypeStruct((n, batch), jnp.int32)] * 3,
+            interpret=_use_interpret(),
+        )(*coordsT, fold_in, pad_in)
+        return tuple(outs)
+
+    return call
+
+
+def g1_add_transposed(spec: FieldSpec, block_b: int = 256, b3: int = 12):
+    """Fused complete add on transposed (n, B) coordinate blocks:
+    (x1,y1,z1,x2,y2,z2) → (x3,y3,z3), bit-identical to CurveOps.add."""
+    return _point_kernel(spec, "add", block_b, b3)
+
+
+def g1_dbl_transposed(spec: FieldSpec, block_b: int = 256, b3: int = 12):
+    """Fused dedicated doubling on transposed (n, B) coordinate blocks."""
+    return _point_kernel(spec, "dbl", block_b, b3)

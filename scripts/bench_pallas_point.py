@@ -1,0 +1,82 @@
+"""Chain-throughput A/B: fused Pallas point ops vs the XLA curve ops.
+
+A chain of K complete adds is the shape of every scalar ladder step.
+Under XLA each field multiply's fold contraction breaks fusion, so a
+point op round-trips intermediates through HBM ~30x; the fused kernel
+keeps them in VMEM.  Honest timing per BASELINE.md r3 rules: fresh
+random inputs each iteration, device_get barrier.
+
+Usage: python scripts/bench_pallas_point.py [B] [K]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+
+def main():
+    from consensus_overlord_tpu.compile_cache import enable
+    enable()
+    from consensus_overlord_tpu.ops import bls12381_groups as dev
+    from consensus_overlord_tpu.ops.curve import Point
+    from consensus_overlord_tpu.ops.field import BLS12_381_FQ as spec
+    from consensus_overlord_tpu.ops.pallas_point import g1_add_transposed
+
+    n = spec.n
+    print(f"device: {jax.devices()[0].platform}  B={B} chain={K}",
+          flush=True)
+    rng = np.random.default_rng(3)
+
+    def fresh():
+        # Loose-bounded random limbs: the add formula is total, and for
+        # throughput the inputs needn't be curve points.
+        return [jnp.asarray(rng.integers(0, 1 << 10, (B, n), np.int32))
+                for _ in range(6)]
+
+    def xla_chain(c):
+        p = Point(c[0], c[1], c[2])
+        q = Point(c[3], c[4], c[5])
+        for _ in range(K):
+            p = dev.G1.add(p, q)
+        return p.x.sum()
+
+    fused = g1_add_transposed(spec, block_b=256)
+
+    def pallas_chain(c):
+        px, py, pz = (jnp.moveaxis(c[0], 0, 1), jnp.moveaxis(c[1], 0, 1),
+                      jnp.moveaxis(c[2], 0, 1))
+        qx, qy, qz = (jnp.moveaxis(c[3], 0, 1), jnp.moveaxis(c[4], 0, 1),
+                      jnp.moveaxis(c[5], 0, 1))
+        for _ in range(K):
+            px, py, pz = fused(px, py, pz, qx, qy, qz)
+        return px.sum()
+
+    for name, fn in (("xla", xla_chain), ("pallas", pallas_chain)):
+        j = jax.jit(fn)
+        jax.device_get(j(fresh()))  # warm
+        best = None
+        for _ in range(3):
+            c = fresh()
+            jax.block_until_ready(c)
+            t0 = time.perf_counter()
+            out = jax.device_get(j(c))
+            dt = (time.perf_counter() - t0) * 1e3
+            print(f"{name:7s} {dt:8.2f} ms  digest={int(out) & 0xffffffff}",
+                  flush=True)
+            best = dt if best is None or dt < best else best
+        print(f"{name}: best {best:.2f} ms "
+              f"({K * B / best * 1000:.0f} adds/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

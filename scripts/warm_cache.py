@@ -1,0 +1,61 @@
+"""Pre-compile the TPU provider kernel set for the pad-ladder rungs a
+deployment will hit, so cold-start consensus rounds don't stall on XLA
+compiles (a fresh kernel at a new batch rung can cost minutes; the
+persistent cache under .jax_cache makes this a one-time cost per
+machine).
+
+Usage: python scripts/warm_cache.py [rung ...]   (default: 32 128 512)
+
+Warms, per rung R: single-hash fused verify (pad R), 2- and 4-group
+fused multi-hash verify, QC pubkey aggregation (g2_sum_rows), signature
+aggregation (g1_validate_sum), and pubkey validation (g2_validate).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main() -> None:
+    from consensus_overlord_tpu.compile_cache import enable
+    enable()
+
+    from consensus_overlord_tpu.core.sm3 import sm3_hash
+    from consensus_overlord_tpu.crypto import bls12381 as oracle
+    from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+
+    rungs = [int(a) for a in sys.argv[1:]] or [32, 128, 512]
+    provider = TpuBlsCrypto(0xFACE, device_threshold=1)
+    top = max(rungs)
+    sks = [4242 + 31 * i for i in range(top)]
+    hs = [sm3_hash(b"warm-%d" % g) for g in range(4)]
+    sigs = {h: [oracle.sign(sk, h) for sk in sks] for h in hs}
+    pks = [oracle.sk_to_pk(sk) for sk in sks]
+    provider.update_pubkeys(pks)  # g2_validate at the pubkey rung
+
+    for rung in rungs:
+        n = rung  # exact rung size (pads to itself)
+        t0 = time.time()
+        assert all(provider.verify_batch(sigs[hs[0]][:n], [hs[0]] * n,
+                                         pks[:n]))
+        print(f"rung {rung}: single-hash {time.time() - t0:.1f}s",
+              flush=True)
+        for k in (2, 4):
+            t0 = time.time()
+            lane_h = [hs[i % k] for i in range(n)]
+            batch = [sigs[lane_h[i]][i] for i in range(n)]
+            assert all(provider.verify_batch(batch, lane_h, pks[:n]))
+            print(f"rung {rung}: {k}-hash {time.time() - t0:.1f}s",
+                  flush=True)
+        t0 = time.time()
+        agg = provider.aggregate_signatures(sigs[hs[0]][:n], pks[:n])
+        assert provider.verify_aggregated_signature(agg, hs[0], pks[:n])
+        print(f"rung {rung}: aggregate+QC {time.time() - t0:.1f}s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
