@@ -606,6 +606,12 @@ class TpuBlsCrypto:
         n = len(voters)
         if n == 0:
             return
+        if n < self._threshold:
+            # Small reconfigure (e.g. a 4-validator net): host validation
+            # is cheaper than a device dispatch round-trip — the same
+            # threshold economics as the verify paths.
+            self._update_pubkeys_host(voters)
+            return
         size = self._pad_to(n)
         parsed = dev.parse_g2_compressed(voters)
         x = np.zeros((size, 2, dev.FQ.n), np.int32)
@@ -621,14 +627,50 @@ class TpuBlsCrypto:
             jnp.asarray(ok)))
         aff = dev.g2_to_oracle(Point(jnp.asarray(px[:n]), jnp.asarray(py[:n]),
                                      jnp.asarray(pz[:n])))
+        self._append_pk_rows(voters, px[:n], py[:n], pz[:n], aff, valid)
+
+    def _append_pk_rows(self, voters: List[bytes], px, py, pz,
+                        aff: List, valid) -> None:
+        """The single cache-append tail both validation paths share: host-
+        and device-validated rows MUST enter the stacked arrays / affine
+        list / index identically or batch gathers desynchronize."""
         base = self._pk_px.shape[0]
-        self._pk_px = np.concatenate([self._pk_px, px[:n]], axis=0)
-        self._pk_py = np.concatenate([self._pk_py, py[:n]], axis=0)
-        self._pk_pz = np.concatenate([self._pk_pz, pz[:n]], axis=0)
+        self._pk_px = np.concatenate([self._pk_px, px], axis=0)
+        self._pk_py = np.concatenate([self._pk_py, py], axis=0)
+        self._pk_pz = np.concatenate([self._pk_pz, pz], axis=0)
         self._pk_aff.extend(aff)
         for i, v in enumerate(voters):
             self._pk_index[v] = base + i if valid[i] else -1
         self._pk_dev = None  # device copy is stale; re-upload lazily
+
+    def _update_pubkeys_host(self, voters: List[bytes]) -> None:
+        """Host-oracle twin of the device validation path: decompress +
+        subgroup-check each key on the CPU and append its limb-encoded
+        affine form (z = 1) to the same stacked cache arrays, so batch
+        kernels gather host- and device-validated rows identically."""
+        n = len(voters)
+        px = np.zeros((n, 2, dev.FQ.n), np.int32)
+        py = np.zeros((n, 2, dev.FQ.n), np.int32)
+        pz = np.zeros((n, 2, dev.FQ.n), np.int32)
+        aff: List[tuple] = []
+        valid = np.zeros(n, bool)
+        for i, v in enumerate(voters):
+            try:
+                pt = oracle.g2_decompress(v)
+            except ValueError:
+                pt = None
+            if pt is None or not oracle.g2_in_subgroup(pt):
+                aff.append(None)
+                continue
+            (x0, x1), (y0, y1) = pt
+            px[i, 0] = dev.FQ.from_int(x0)
+            px[i, 1] = dev.FQ.from_int(x1)
+            py[i, 0] = dev.FQ.from_int(y0)
+            py[i, 1] = dev.FQ.from_int(y1)
+            pz[i, 0] = dev.FQ.from_int(1)
+            valid[i] = True
+            aff.append(pt)
+        self._append_pk_rows(voters, px, py, pz, aff, valid)
 
     def _pk_device(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """The device-resident pubkey cache, padded to the capacity

@@ -39,6 +39,7 @@ from __future__ import annotations
 import asyncio
 import enum
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -121,6 +122,15 @@ class _Committed:
     status: Optional[Status]
 
 
+@dataclass(frozen=True)
+class _CommitRetry:
+    """Timer-driven re-drive of a failed adapter.commit (the reference
+    Brain::commit posture, src/consensus.rs:594-657: a commit that errors
+    must eventually land, not wait for an external duplicate QC or the
+    ping_controller resync)."""
+    height: int
+
+
 class _Stop:
     pass
 
@@ -184,7 +194,7 @@ class Engine:
 
     def __init__(self, name: Address, adapter: ConsensusAdapter,
                  crypto: CryptoProvider, wal: Wal,
-                 frontier=None):
+                 frontier=None, tracer=None):
         self.name = bytes(name)
         self.adapter = adapter
         self.crypto = crypto
@@ -198,6 +208,19 @@ class Engine:
         #: without a verifier actually guarding the injection path.
         self.frontier = frontier
         self.inbound_verified = frontier is not None
+        #: Optional span exporter (obs/tracing.JaegerExporter).  The
+        #: reference #[instrument]s its consensus entry points
+        #: (src/main.rs:91,106,132; src/consensus.rs:96,143,209); here the
+        #: engine itself emits the round lifecycle: one trace per height,
+        #: a span per round, and QC-verify spans carrying batch size — so
+        #: a Jaeger trace shows consensus progress, not just RPC
+        #: envelopes.  Lossy/no-op when unset; never blocks the loop.
+        self.tracer = tracer
+        self._trace_id = 0
+        self._height_span_id = 0
+        self._height_start_us = 0
+        self._round_span_id = 0
+        self._round_start_us = 0
         self._mailbox: asyncio.Queue = asyncio.Queue()
         self.handler = EngineHandler(self._mailbox)
 
@@ -223,6 +246,11 @@ class Engine:
         self._my_prevote_round: Optional[int] = None
         self._my_precommit_round: Optional[int] = None
         self._committing = False
+        #: The commit being driven for this height, kept so a failed
+        #: adapter.commit re-drives from a timer instead of waiting for a
+        #: duplicate QC broadcast or the ping_controller resync.
+        self._pending_commit: Optional[Commit] = None
+        self._commit_retry_timer: Optional[asyncio.TimerHandle] = None
 
         self._pending: List[object] = []  # future-height/round buffer
         self._timers: Dict[Step, asyncio.TimerHandle] = {}
@@ -278,6 +306,7 @@ class Engine:
                     self.lock_proposal.content
             logger.info("%s: WAL recovery to height=%d round=%d",
                         self._tag(), start_height, start_round)
+        self._trace_begin_height()
         await self._enter_round(start_round)
         try:
             while self._running:
@@ -291,7 +320,12 @@ class Engine:
                                      type(msg).__name__)
         finally:
             self._running = False
+            self._trace_end_round()
+            self._trace_end_height(committed=False)
             self._cancel_timers()
+            if self._commit_retry_timer is not None:
+                self._commit_retry_timer.cancel()
+                self._commit_retry_timer = None
             for t in list(self._tasks):
                 t.cancel()
 
@@ -398,14 +432,26 @@ class Engine:
         self._my_prevote_round = None
         self._my_precommit_round = None
         self._committing = False
+        self._pending_commit = None
+        if self._commit_retry_timer is not None:
+            self._commit_retry_timer.cancel()
+            self._commit_retry_timer = None
         # Note: the lock (lock_round/lock_proposal/lock_qc) is deliberately
         # NOT cleared here — it survives rounds and is cleared only on a
         # height change (_enter_new_height) or stale-recovery reset (run()).
 
-    async def _enter_new_height(self, status: Status) -> None:
+    async def _enter_new_height(self, status: Status,
+                                committed: bool = True) -> None:
+        """committed=False: a RichStatus resync pulled us forward without
+        this node having committed the abandoned height (the span tag
+        must distinguish the two — the stuck-commit-pulled-forward case
+        is exactly when the trace matters)."""
         logger.info("%s: commit/status -> height %d", self._tag(), status.height)
+        self._trace_end_round()
+        self._trace_end_height(committed=committed)
         self._last_commit_ts = asyncio.get_running_loop().time()
         self.height = status.height
+        self._trace_begin_height()
         self.round = 0
         if status.interval:
             self.interval_ms = status.interval
@@ -421,8 +467,10 @@ class Engine:
         self._drain_pending()
 
     async def _enter_round(self, round_: int) -> None:
+        self._trace_end_round()
         self.round = round_
         self.step = Step.PROPOSE
+        self._trace_begin_round()
         self._cancel_timers()
         # Drop per-round state that fell out of the live-round window
         # (memory stays O(ROUND_WINDOW) regardless of round spray).
@@ -468,6 +516,48 @@ class Engine:
         task = asyncio.get_running_loop().create_task(coro)
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
+
+    # -- tracing -----------------------------------------------------------
+
+    def _emit_span(self, operation: str, span_id: int, parent: int,
+                   start_us: int, tags: Dict[str, str]) -> None:
+        if self.tracer is None or start_us == 0:
+            return
+        from ..obs.tracing import Span
+        self.tracer.report(Span(
+            trace_id=self._trace_id, span_id=span_id, parent_span_id=parent,
+            operation=operation, start_us=start_us,
+            duration_us=max(int(time.time() * 1e6) - start_us, 1),
+            tags=tags))
+
+    def _trace_begin_height(self) -> None:
+        if self.tracer is None:
+            return
+        from ..obs.tracing import new_span_id, new_trace_id
+        self._trace_id = new_trace_id()
+        self._height_span_id = new_span_id()
+        self._height_start_us = int(time.time() * 1e6)
+
+    def _trace_end_height(self, committed: bool) -> None:
+        self._emit_span("consensus.height", self._height_span_id, 0,
+                        self._height_start_us,
+                        {"height": str(self.height),
+                         "committed": str(committed).lower()})
+        self._height_start_us = 0
+
+    def _trace_begin_round(self) -> None:
+        if self.tracer is None:
+            return
+        from ..obs.tracing import new_span_id
+        self._round_span_id = new_span_id()
+        self._round_start_us = int(time.time() * 1e6)
+
+    def _trace_end_round(self) -> None:
+        self._emit_span("consensus.round", self._round_span_id,
+                        self._height_span_id, self._round_start_us,
+                        {"height": str(self.height), "round": str(self.round),
+                         "step": Step(self.step).name.lower()})
+        self._round_start_us = 0
 
     # -- proposing ---------------------------------------------------------
 
@@ -534,6 +624,8 @@ class Engine:
             await self._on_block_checked(msg)
         elif isinstance(msg, _Committed):
             await self._on_committed(msg)
+        elif isinstance(msg, _CommitRetry):
+            await self._on_commit_retry(msg)
         else:
             logger.warning("%s: unknown mailbox message %r", self._tag(), msg)
 
@@ -561,7 +653,7 @@ class Engine:
             logger.debug("%s: stale RichStatus(%d) ignored", self._tag(),
                          status.height)
             return
-        await self._enter_new_height(status)
+        await self._enter_new_height(status, committed=False)
 
     # -- proposal handling -------------------------------------------------
 
@@ -622,11 +714,23 @@ class Engine:
         if self._weight_of(voters) < quorum_weight(self._total_weight()):
             return False
         vote_hash = sm3_hash(qc.to_vote().encode())
+        start_us = int(time.time() * 1e6)
         if self.frontier is not None:
-            return await self.frontier.verify_aggregated(
+            ok = await self.frontier.verify_aggregated(
                 qc.signature.signature, vote_hash, voters)
-        return self.crypto.verify_aggregated_signature(
-            qc.signature.signature, vote_hash, voters)
+        else:
+            ok = self.crypto.verify_aggregated_signature(
+                qc.signature.signature, vote_hash, voters)
+        if self.tracer is not None:
+            from ..obs.tracing import new_span_id
+            self._emit_span("consensus.qc_verify", new_span_id(),
+                            self._round_span_id, start_us,
+                            {"height": str(qc.height),
+                             "round": str(qc.round),
+                             "vote_type": VoteType(qc.vote_type).name.lower(),
+                             "batch": str(len(voters)),
+                             "ok": str(ok).lower()})
+        return ok
 
     async def _check_block(self, height: int, round_: int, block_hash: Hash,
                            content: bytes) -> None:
@@ -796,7 +900,8 @@ class Engine:
             return
         self._committing = True
         proof = Proof(qc.height, qc.round, qc.block_hash, qc.signature)
-        self._spawn(self._commit(qc.height, Commit(qc.height, content, proof)))
+        self._pending_commit = Commit(qc.height, content, proof)
+        self._spawn(self._commit(qc.height, self._pending_commit))
 
     async def _commit(self, height: int, commit: Commit) -> None:
         try:
@@ -811,10 +916,26 @@ class Engine:
         if msg.height != self.height:
             return
         if msg.status is None:
-            # Commit failed — allow retry on a future QC.
-            self._committing = False
+            # Commit failed — keep the QC'd commit and re-drive it from a
+            # timer (reference Brain::commit retry posture,
+            # src/consensus.rs:594-657).  _committing stays True so a
+            # duplicate QC can't double-spawn; the height transition on
+            # success (or a resync RichStatus) clears the retry state.
+            delay = max(0.05, self.interval_ms / 1000.0 / 2)
+            loop = asyncio.get_running_loop()
+            self._commit_retry_timer = loop.call_later(
+                delay,
+                lambda: self._mailbox.put_nowait(_CommitRetry(msg.height)))
             return
         await self._enter_new_height(msg.status)
+
+    async def _on_commit_retry(self, msg: _CommitRetry) -> None:
+        if (msg.height != self.height or not self._committing
+                or self._pending_commit is None):
+            return
+        logger.info("%s: retrying commit at height %d", self._tag(),
+                    msg.height)
+        self._spawn(self._commit(msg.height, self._pending_commit))
 
     # -- choke / view change ----------------------------------------------
 

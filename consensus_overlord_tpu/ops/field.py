@@ -39,6 +39,7 @@ Reference anchor: this replaces the limb arithmetic inside blst
 
 from __future__ import annotations
 
+import os
 from typing import List, Sequence, Tuple
 
 import jax
@@ -224,10 +225,40 @@ class FieldSpec:
         return self._reduce(self._pad - x, [int(self._pad_np.max())] * self.n)
 
     def mul(self, x: Array, y: Array) -> Array:
+        """Product convolution Σ_{i+j=k} x_i·y_j, then reduce.  Two
+        formulations with identical arithmetic and bounds, chosen per
+        backend at trace time (measured A/B, 2026-07 r4):
+
+        * staircase (CPU): the outer-product matrix P[i,j] = x_i·y_j padded
+          to row width 2n, flattened, truncated by n, re-rowed at width
+          2n−1 — which right-shifts row i by exactly i, so a row-sum is the
+          convolution.  6 HLO ops per mul instead of ~80; cut the fused
+          verify kernel's cold trace+compile 3.2x (343 s → 108 s), which is
+          what the test suite and the driver's CPU-mesh dryrun pay.
+        * shifted-add (TPU): n static pads + adds.  On TPU the staircase's
+          padded (B, n, 2n) intermediate defeats fusion and goes through
+          HBM (~100 MB/mul at B=8192) — measured 12x THROUGHPUT LOSS
+          (18.1k → 1.55k verifies/s/chip), so the runtime path keeps the
+          fully-fusable form.
+
+        CONSENSUS_FIELD_MUL=staircase|padsum overrides the auto choice."""
         n = self.n
-        # Product convolution as shifted adds, NOT in-place slice updates:
-        # n chained .at[].add updates serialize the graph and blow XLA
-        # compile time up ~50x per mul; n static pads reassociate freely.
+        form = os.environ.get("CONSENSUS_FIELD_MUL", "auto")
+        if form not in ("auto", "staircase", "padsum"):
+            raise ValueError(
+                f"CONSENSUS_FIELD_MUL={form!r}: expected auto|staircase|"
+                "padsum (a typo here would silently trace the slow-compile "
+                "form)")
+        if form == "auto":
+            import jax as _jax
+            form = ("staircase" if _jax.default_backend() == "cpu"
+                    else "padsum")
+        if form == "staircase":
+            P = x[..., :, None] * y[..., None, :]
+            P = jnp.pad(P, [(0, 0)] * (P.ndim - 2) + [(0, 0), (0, n)])
+            flat = P.reshape(P.shape[:-2] + (2 * n * n,))[..., :2 * n * n - n]
+            stair = flat.reshape(flat.shape[:-1] + (n, 2 * n - 1))
+            return self._reduce(stair.sum(-2), self._conv_bounds())
         terms = [
             jnp.pad(x[..., i:i + 1] * y,
                     [(0, 0)] * (max(x.ndim, y.ndim) - 1) + [(i, n - 1 - i)])

@@ -48,6 +48,11 @@ class ConsensusConfig:
     crypto_backend: str = "tpu"          # "tpu" | "cpu"
     frontier_max_batch: int = 1024
     frontier_linger_ms: float = 2.0
+    #: gRPC method-path namespace: "native" serves/dials
+    #: consensus_overlord_tpu.* paths; "cita_cloud" uses the reference
+    #: mesh's cita_cloud_proto package names (src/main.rs:64-73) so this
+    #: node can register with a reference network/controller pair.
+    proto_compat: str = "native"         # "native" | "cita_cloud"
 
     @classmethod
     def load(cls, path: str,

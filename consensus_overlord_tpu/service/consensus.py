@@ -71,10 +71,14 @@ class Consensus:
     def __init__(self, config: ConsensusConfig, private_key: int,
                  controller: Optional[ControllerClient] = None,
                  network: Optional[NetworkClient] = None,
-                 crypto=None):
+                 crypto=None, tracer=None):
         self.config = config
-        self.controller = controller or ControllerClient(config.controller_port)
-        self.network = network or NetworkClient(config.network_port)
+        # Explicit compat: method paths bake at construction, and the
+        # global default is shared process-wide (rpc.full_service_name).
+        self.controller = controller or ControllerClient(
+            config.controller_port, compat=config.proto_compat)
+        self.network = network or NetworkClient(
+            config.network_port, compat=config.proto_compat)
         self.crypto = crypto or _make_crypto(config.crypto_backend, private_key)
         self.wal = FileWal(config.wal_path)
         self.brain = GrpcBrain(self.crypto, self.controller, self.network)
@@ -84,8 +88,11 @@ class Consensus:
         self.frontier = BatchingVerifier(
             self.crypto, max_batch=config.frontier_max_batch,
             linger_s=config.frontier_linger_ms / 1000.0)
+        # tracer: the engine emits height/round/QC-verify spans through the
+        # same exporter the gRPC layer uses (reference #[instrument]
+        # coverage, src/consensus.rs:96,143,209).
         self.engine = Engine(self.crypto.pub_key, self.brain, self.crypto,
-                             self.wal, frontier=self.frontier)
+                             self.wal, frontier=self.frontier, tracer=tracer)
         #: Last applied configuration (reference `reconfigure:
         #: Arc<RwLock<Option<ConsensusConfiguration>>>`, src/consensus.rs:55).
         self.reconfigure: Optional[pb2.ConsensusConfiguration] = None

@@ -53,6 +53,10 @@ class ServiceRuntime:
         self.config = config
         self._private_key = private_key
         self._host = host
+        # Must precede any handler/client construction: method paths are
+        # baked in at build time (reference mesh join, src/main.rs:64-73).
+        from .rpc import set_proto_compat
+        set_proto_compat(config.proto_compat)
         self.metrics = (Metrics(config.metrics_buckets)
                         if config.enable_metrics else None)
         # Jaeger span export when the config names an agent (reference
@@ -72,13 +76,15 @@ class ServiceRuntime:
     async def start(self) -> int:
         """Bring the service up; returns the bound consensus port."""
         cfg = self.config
-        self.consensus = Consensus(cfg, self._private_key)
+        self.consensus = Consensus(cfg, self._private_key,
+                                   tracer=self.tracer)
         interceptors = [TraceContextInterceptor(exporter=self.tracer)]
         if self.metrics is not None:
             interceptors.append(self.metrics.interceptor())
         self._server, self.bound_port = build_server(
             ConsensusServer(self.consensus), port=cfg.consensus_port,
-            interceptors=interceptors, host=self._host)
+            interceptors=interceptors, host=self._host,
+            compat=cfg.proto_compat)
         await self._server.start()
         logger.info("grpc server on port %d", self.bound_port)
 

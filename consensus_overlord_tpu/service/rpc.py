@@ -24,6 +24,49 @@ logger = logging.getLogger("consensus_overlord_tpu.rpc")
 
 _PKG = "consensus_overlord_tpu"
 
+#: CITA-Cloud wire-compat service naming (VERDICT r3 item 8): the
+#: reference serves/consumes `cita_cloud_proto` package paths
+#: (src/main.rs:64-73: consensus.ConsensusService,
+#: network.NetworkMsgHandlerService / network.NetworkService,
+#: controller.Consensus2ControllerService, grpc.health.v1.Health), while
+#: this framework's native mode namespaces everything under its own
+#: package.  `set_proto_compat("cita_cloud")` switches every served and
+#: dialed method path to the reference's names so a node can join a
+#: reference mesh.  Message field layouts were already re-specified from
+#: the reference's observed behavior (protos/consensus_overlord.proto).
+_CITA_CLOUD_SERVICES = {
+    "ConsensusService": "consensus.ConsensusService",
+    "NetworkMsgHandlerService": "network.NetworkMsgHandlerService",
+    "NetworkService": "network.NetworkService",
+    "Consensus2ControllerService": "controller.Consensus2ControllerService",
+    "Health": "grpc.health.v1.Health",
+}
+_proto_compat = "native"
+
+
+def set_proto_compat(mode: str) -> None:
+    """'native' (default) or 'cita_cloud' — applies to handlers/clients
+    built AFTER the call (service startup sets it before wiring)."""
+    global _proto_compat
+    if mode not in ("native", "cita_cloud"):
+        raise ValueError(f"unknown proto_compat mode {mode!r}")
+    _proto_compat = mode
+
+
+def full_service_name(service_name: str,
+                      compat: Optional[str] = None) -> str:
+    """compat=None falls back to the process default (set_proto_compat).
+    Handlers/clients bake method paths at construction, so components
+    built for a specific runtime should pass their config's mode
+    explicitly — two runtimes with different modes in one process would
+    otherwise race on the global."""
+    mode = compat if compat is not None else _proto_compat
+    if mode == "cita_cloud":
+        return _CITA_CLOUD_SERVICES[service_name]
+    if mode != "native":
+        raise ValueError(f"unknown proto_compat mode {mode!r}")
+    return f"{_PKG}.{service_name}"
+
 
 class Code:
     SUCCESS = 0
@@ -58,7 +101,8 @@ CONTROLLER_SERVICE = {
 
 
 def generic_handler(service_name: str, methods: Dict[str, tuple],
-                    impl) -> grpc.GenericRpcHandler:
+                    impl, compat: Optional[str] = None
+                    ) -> grpc.GenericRpcHandler:
     """Build a generic handler binding `impl.<SnakeCase>` coroutines to the
     service's methods."""
     handlers = {}
@@ -72,7 +116,7 @@ def generic_handler(service_name: str, methods: Dict[str, tuple],
             request_deserializer=req_cls.FromString,
             response_serializer=resp_cls.SerializeToString)
     return grpc.method_handlers_generic_handler(
-        f"{_PKG}.{service_name}", handlers)
+        full_service_name(service_name, compat), handlers)
 
 
 class RetryClient:
@@ -82,14 +126,14 @@ class RetryClient:
 
     def __init__(self, address: str, service_name: str,
                  methods: Dict[str, tuple], retries: int = 3,
-                 retry_delay_s: float = 0.3):
+                 retry_delay_s: float = 0.3, compat: Optional[str] = None):
         self._channel = grpc.aio.insecure_channel(address)
         self._retries = retries
         self._delay = retry_delay_s
         self._calls = {}
         for method, (req_cls, resp_cls) in methods.items():
             self._calls[method] = self._channel.unary_unary(
-                f"/{_PKG}.{service_name}/{method}",
+                f"/{full_service_name(service_name, compat)}/{method}",
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=resp_cls.FromString)
 

@@ -83,17 +83,21 @@ class HealthServer:
 def build_server(consensus_server: ConsensusServer,
                  port: int = 0,
                  interceptors: Optional[Sequence] = None,
-                 host: str = "[::]") -> tuple[grpc.aio.Server, int]:
+                 host: str = "[::]",
+                 compat: Optional[str] = None) -> tuple[grpc.aio.Server, int]:
     """Assemble the three services into one grpc.aio server (reference
     src/main.rs:262-296).  Returns (server, bound_port) — port 0 lets the
-    OS pick (used by tests)."""
+    OS pick (used by tests).  compat: proto_compat mode for the served
+    method paths (None = process default)."""
     server = grpc.aio.server(interceptors=list(interceptors or ()))
     server.add_generic_rpc_handlers((
         generic_handler("ConsensusService", CONSENSUS_SERVICE,
-                        consensus_server),
+                        consensus_server, compat=compat),
         generic_handler("NetworkMsgHandlerService",
-                        NETWORK_MSG_HANDLER_SERVICE, consensus_server),
-        generic_handler("Health", HEALTH_SERVICE, HealthServer()),
+                        NETWORK_MSG_HANDLER_SERVICE, consensus_server,
+                        compat=compat),
+        generic_handler("Health", HEALTH_SERVICE, HealthServer(),
+                        compat=compat),
     ))
     bound = server.add_insecure_port(f"{host}:{port}")
     return server, bound

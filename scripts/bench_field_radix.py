@@ -1,0 +1,201 @@
+"""Raw-arithmetic microbench behind the r4 field-multiply rethink
+(VERDICT r3 item 3): per-MAC cost int32 vs f32, and whether an
+alternative formulation (f32 b=7 radix, MXU-shaped dot_general
+Toeplitz contraction) can beat the int32 b=10 schoolbook convolution.
+
+Measurement shape (the PJRT-relay honesty rules, BASELINE.md): inputs
+stay ON DEVICE, each measured call chains K DEPENDENT applications
+under one jit (no loop-invariant hoisting possible — every step
+consumes the previous result), a fresh device salt decorrelates
+iterations, and only a checksum scalar is downloaded.  A first timing
+pass of this script uploaded fresh (8192, 512) arrays per call and
+"measured" 345 ms per field-mul — that was the ~30 MB/s tunnel, not
+the chip; kept as a warning.
+
+Usage: python scripts/bench_field_radix.py [B] [K]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from consensus_overlord_tpu.compile_cache import enable
+
+enable()
+from consensus_overlord_tpu.ops.field import BLS12_381_FQ as FQ
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+ITERS = 6
+rng = np.random.default_rng(7)
+n = FQ.n
+
+
+def timed(name, make_chain, *arrays, macs_per_step=None):
+    """SLOPE timing: median call time at chain lengths K and 2K; the
+    difference divided by K is the per-step cost with the fixed
+    dispatch+readback round-trip of the PJRT tunnel (~120-200 ms
+    regardless of work) subtracted out.  A flat-K version of this
+    script measured every formulation at ~1.9 ms/step — that was the
+    link floor, not the chip."""
+    devs = [jnp.asarray(a) for a in arrays]
+
+    def median_call(fn):
+        ts = []
+        for i in range(ITERS + 1):
+            salt = jnp.int32(i) if devs[0].dtype == jnp.int32 \
+                else jnp.float32(i)
+            t0 = time.time()
+            jax.device_get(fn(*devs, salt))
+            ts.append(time.time() - t0)
+        return sorted(ts[1:])[len(ts[1:]) // 2]
+
+    t1 = median_call(jax.jit(make_chain(K)))
+    t2 = median_call(jax.jit(make_chain(2 * K)))
+    per_step = max((t2 - t1) / K, 1e-9)
+    extra = ""
+    if macs_per_step:
+        extra = f"  ({macs_per_step / per_step / 1e9:6.1f} GMAC/s)"
+    print(f"  {name:<40s} {per_step * 1e6:9.1f} us/step{extra}"
+          f"   [K call {t1 * 1e3:.0f} ms, 2K {t2 * 1e3:.0f} ms]")
+    return per_step
+
+
+def main():
+    print(f"backend={jax.default_backend()} B={B} K={K}")
+
+    # -- 1. raw elementwise MAC cost (dependent chain) ------------------
+    shape = (B, 512)
+    yi = rng.integers(1, 1 << 11, shape, dtype=np.int32)
+
+    def chain_i32(length):
+        def fn(y, salt):
+            def step(c, _):
+                return (c * y + salt) & 0x3FFFFF, None
+            c, _ = lax.scan(step, y + salt, None, length=length)
+            return c.sum()
+        return fn
+
+    def chain_f32(length):
+        def fn(y, salt):
+            def step(c, _):
+                c = c * y + salt
+                # keep values bounded+exact: wrap at 2^22
+                return c - jnp.floor(c * (1 / (1 << 22))) * (1 << 22), None
+            c, _ = lax.scan(step, y + salt, None, length=length)
+            return c.sum()
+        return fn
+
+    mac = B * 512
+    print(f"-- elementwise mul+add, {shape}, dependent {K}-chain --")
+    ti = timed("int32 mul+add+mask", chain_i32, yi, macs_per_step=mac)
+    tf = timed("f32 mul+add+wrap", chain_f32, yi.astype(np.float32),
+               macs_per_step=mac)
+    print(f"  int32/f32 per-step ratio: {ti / tf:.2f}x "
+          f"(f32 b=7 radix needs >2x to pay for its 2x limbs)")
+
+    # -- 2. field-mul formulations (dependent chains) -------------------
+    yl = rng.integers(0, FQ.loose_max + 1, (B, n), dtype=np.int32)
+    fmac = B * n * n
+
+    def field_chain(mul):
+        def make(length):
+            def fn(y, salt):
+                def step(c, _):
+                    return mul(c, y), None
+                c, _ = lax.scan(
+                    step, FQ.add(y, jnp.broadcast_to(salt, y.shape)),
+                    None, length=length)
+                return FQ.strict(c).sum()
+            return fn
+        return make
+
+    chain_cur = field_chain(FQ.mul)
+
+    print(f"-- field multiply chains, B={B} --")
+    t_cur = timed("int32 b=10 n=39 shifted-add (current)", chain_cur, yl,
+                  macs_per_step=fmac)
+
+    # MXU-shaped: gather-built Toeplitz + batched dot_general, then the
+    # SAME static reduce — bit-identical to FieldSpec.mul by the assert
+    # below, so this is a drop-in formulation if it wins.
+    idx = np.arange(2 * n - 1)[None, :] - np.arange(n)[:, None]
+    mask = jnp.asarray(((idx >= 0) & (idx < n)).astype(np.int32))
+    idxc = jnp.asarray(np.clip(idx, 0, n - 1))
+
+    def mul_dotgen(x, y):
+        T = y[:, idxc] * mask  # (B, n, 2n-1)
+        conv = lax.dot_general(
+            x[:, None, :], T, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)[:, 0, :]
+        return FQ._reduce(conv, FQ._conv_bounds())
+
+    chain_dotgen = field_chain(mul_dotgen)
+
+    t_dg = timed("int32 dot_general Toeplitz + reduce", chain_dotgen, yl,
+                 macs_per_step=fmac)
+
+    # staircase (the CPU-compile formulation) on TPU, for the record.
+    def mul_stair(x, y):
+        P = x[..., :, None] * y[..., None, :]
+        P = jnp.pad(P, [(0, 0), (0, 0), (0, n)])
+        flat = P.reshape(P.shape[:-2] + (2 * n * n,))[..., :2 * n * n - n]
+        st = flat.reshape(flat.shape[:-1] + (n, 2 * n - 1))
+        return FQ._reduce(st.sum(-2), FQ._conv_bounds())
+
+    chain_stair = field_chain(mul_stair)
+
+    t_st = timed("int32 staircase reshape + reduce", chain_stair, yl,
+                 macs_per_step=fmac)
+
+    # f32 b=7 n=55 conv + minimal carry wrap (NOT exact field math — a
+    # cost floor for any real f32 reduce, which needs at least one
+    # carry pass; decides whether the float radix is worth building).
+    n7 = 55
+    y7 = rng.integers(0, 1 << 9, (B, n7)).astype(np.float32)
+
+    def chain_f32field(length):
+        def fn(y, salt):
+            def step(c, _):
+                terms = [
+                    jnp.pad(c[..., i:i + 1] * y, [(0, 0), (i, n7 - 1 - i)])
+                    for i in range(n7)
+                ]
+                out = terms[0]
+                for t in terms[1:]:
+                    out = out + t
+                hi = jnp.floor(out * (1.0 / (1 << 7)))
+                lo = out - hi * (1 << 7)
+                folded = lo[..., :n7] + hi[..., :n7] * 3.0  # stand-in fold
+                return folded, None
+            c, _ = lax.scan(step, y + salt, None, length=length)
+            return c.sum()
+        return fn
+
+    t_f = timed("f32 b=7 n=55 conv + carry wrap", chain_f32field, y7,
+                macs_per_step=B * n7 * n7)
+
+    # Bit-identical check: dot_general formulation vs FieldSpec.mul.
+    xs = jnp.asarray(rng.integers(0, FQ.loose_max + 1, (256, n),
+                                  dtype=np.int32))
+    ys = jnp.asarray(rng.integers(0, FQ.loose_max + 1, (256, n),
+                                  dtype=np.int32))
+    a = jax.device_get(jax.jit(FQ.mul)(xs, ys))
+    b = jax.device_get(jax.jit(mul_dotgen)(xs, ys))
+    assert np.array_equal(FQ.strict(jnp.asarray(a)),
+                          FQ.strict(jnp.asarray(b))), "dot_general drifts"
+
+    print("-- summary --")
+    print(f"  dot_general/current {t_dg / t_cur:.2f}x, "
+          f"staircase/current {t_st / t_cur:.2f}x, "
+          f"f32(b=7 floor)/current {t_f / t_cur:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""On-chip A/B: G2 MSM via per-pubkey PRECOMPUTED window tables vs the
+windowed double-and-add ladder (r4, VERDICT item 3 follow-on).
+
+The r3/r4 ledger killed every arithmetic reformulation of the field
+multiply (Pippenger 2.1x slower, Pallas ~1.0x, dot_general-Toeplitz
+2.18x, staircase 4.58x, f32-radix floor 1.79x; the current mul runs at
+~47% of the chip's practical int32 elementwise ceiling).  The remaining
+structural lever: the verify relation's G2 MSM Σ r_i·P_i runs over
+pubkeys that are CACHED on device between reconfigures, so the
+16-window × 16-digit multiples d·16^j·P_i can be precomputed ONCE per
+reconfigure.  Per lane the MSM then costs 16 table gathers + 15 adds —
+the 64 accumulator doublings (the ladder's dominant term: 64 of 80
+point ops) vanish from the per-round path.
+
+Memory: 256 points/key × 936 B (projective 2×39-limb int32 ×3 coords)
+≈ 240 KB/key → 2.0 GB at 8192 cached keys (v5e HBM 16 GB).
+
+This script measures both formulations at B lanes with fresh 64-bit
+scalars per iteration (slope timing over a dependent chain is
+impossible here — an MSM is one reduction — so it uses distinct-input
+dispatch pipelining like bench.py) and asserts bit-identical strict
+affine outputs.
+
+Usage: python scripts/bench_g2_table_msm.py [B] [ITERS]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from consensus_overlord_tpu.compile_cache import enable
+
+enable()
+from consensus_overlord_tpu.crypto import bls12381 as oracle
+from consensus_overlord_tpu.ops import bls12381_groups as dev
+from consensus_overlord_tpu.ops.curve import Point
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+ITERS = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+WINDOWS = 16  # 64-bit RLC weights, w=4
+DIGITS = 16
+
+
+def build_tables(pk: Point) -> Point:
+    """(R, ...) pubkeys → (R, WINDOWS, DIGITS, ...) multiples
+    T[r, j, d] = d · 16^j · P_r, MS-window-first (j=0 is the most
+    significant window, matching unpack_weight_bits' MSB-first bits).
+    Build cost ≈ 60 doublings + 15×16 adds per key, batched over keys —
+    paid once per reconfigure, not per round."""
+    g2 = dev.G2
+
+    def window_step(p, _):
+        nxt = p
+        for _ in range(4):
+            nxt = g2.dbl(nxt)
+        return nxt, p  # collect 16^j·P for j = 0.. (LS first)
+
+    _, per_win = lax.scan(window_step, pk, None, length=WINDOWS)
+    # per_win: (WINDOWS, R, ...) with j=0 least significant; flip so
+    # j=0 is the MOST significant window.
+    per_win = Point(per_win.x[::-1], per_win.y[::-1], per_win.z[::-1])
+
+    def digit_step(acc, _):
+        nxt = g2.add(acc, per_win)
+        return nxt, acc  # collect d·16^j·P for d = 0..
+
+    inf = g2.infinity_like(per_win.x)
+    _, tab = lax.scan(digit_step, inf, None, length=DIGITS)
+    # tab: (DIGITS, WINDOWS, R, ...) → (R, WINDOWS, DIGITS, ...)
+    perm = (2, 1, 0) + tuple(range(3, tab.x.ndim))
+    return Point(tab.x.transpose(perm), tab.y.transpose(perm),
+                 tab.z.transpose(perm))
+
+
+def msm_tables(tab: Point, rows, bits) -> Point:
+    """Σ_i k_i·P_{rows_i} from precomputed tables: per lane, gather one
+    point per window by (row, window, digit) and tree-sum 16 points.
+    No doublings anywhere."""
+    g2 = dev.G2
+    digits = (bits.reshape(bits.shape[0], WINDOWS, 4)
+              * jnp.asarray([8, 4, 2, 1], jnp.int32)).sum(-1)  # (B, 16)
+    r = rows[:, None].astype(jnp.int32)
+    j = jnp.arange(WINDOWS, dtype=jnp.int32)[None, :]
+    pts = Point(tab.x[r, j, digits], tab.y[r, j, digits],
+                tab.z[r, j, digits])  # (B, 16, ...)
+    # Tree-sum over the window axis (4 levels), then over lanes.
+    p = pts
+    width = WINDOWS
+    while width > 1:
+        half = width // 2
+        p = g2.add(Point(p.x[:, :half], p.y[:, :half], p.z[:, :half]),
+                   Point(p.x[:, half:], p.y[:, half:], p.z[:, half:]))
+        width = half
+    per_lane = Point(p.x[:, 0], p.y[:, 0], p.z[:, 0])
+    return g2.tree_sum(per_lane)
+
+
+def main():
+    print(f"backend={jax.default_backend()} B={B}")
+    rng = np.random.default_rng(11)
+
+    # Distinct pubkeys, one cache row per lane (worst case for tables).
+    sks = [1000 + 7 * i for i in range(B)]
+    pks_aff = [oracle.g2_decompress(oracle.sk_to_pk(sk)) for sk in sks]
+    pk = dev.g2_from_oracle(pks_aff)
+    rows = jnp.arange(B, dtype=jnp.int64)
+
+    t0 = time.time()
+    tab = jax.block_until_ready(jax.jit(build_tables)(pk))
+    t_build = time.time() - t0
+    gb = sum(a.nbytes for a in (tab.x, tab.y, tab.z)) / 1e9
+    print(f"  table build (one-time, incl. compile): {t_build:.1f} s, "
+          f"{gb:.2f} GB on device")
+
+    ladder = jax.jit(lambda p, bits: dev.G2.msm_bits(p, bits))
+    tmsm = jax.jit(lambda tab_, rows_, bits: msm_tables(tab_, rows_, bits))
+
+    @jax.jit
+    def aff(p):
+        # STRICT affine coords: to_affine alone returns loose limbs,
+        # which differ between projective representatives of the same
+        # point — comparing those reports false drift.
+        ax, ay, ainf = dev.G2.to_affine(p)
+        return dev.FQ.strict(ax), dev.FQ.strict(ay), ainf
+
+    def run(fn, *args):
+        return jax.device_get(aff(fn(*args)))
+
+    def bench(name, dispatch):
+        # fresh scalars per iteration (the relay dedupes identical work)
+        ts = []
+        out = None
+        for i in range(ITERS + 1):
+            w = rng.integers(0, 2, (B, 64), dtype=np.int64).astype(np.int32)
+            w[:, 0] = 1
+            bits = jnp.asarray(w)
+            jax.block_until_ready(bits)
+            t0 = time.time()
+            out = dispatch(bits)
+            ts.append(time.time() - t0)
+        med = sorted(ts[1:])[len(ts[1:]) // 2]
+        print(f"  {name:<34s} {med * 1e3:8.1f} ms/MSM")
+        return med, out
+
+    t_lad, _ = bench("windowed ladder (current)",
+                     lambda bits: run(ladder, pk, bits))
+    t_tab, _ = bench("precomputed tables (gather+add)",
+                     lambda bits: run(tmsm, tab, rows, bits))
+
+    # Bit-identical outputs on one fixed scalar set.
+    w = rng.integers(0, 2, (B, 64), dtype=np.int64).astype(np.int32)
+    w[:, 0] = 1
+    bits = jnp.asarray(w)
+    a = run(ladder, pk, bits)
+    b = run(tmsm, tab, rows, bits)
+    for xa, xb in zip(a, b):
+        assert np.array_equal(np.asarray(xa), np.asarray(xb)), "MSM drift"
+
+    print(f"-- summary: tables/ladder {t_tab / t_lad:.2f}x "
+          f"({'WIN' if t_tab < t_lad else 'LOSS'}) --")
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,34 @@ class TestFaults:
         run(main())
 
 
+class TestCommitRetry:
+    def test_failed_commit_is_retried_from_timer(self):
+        """A failed adapter.commit must be re-driven by the engine itself
+        (reference Brain::commit retry posture, src/consensus.rs:594-657) —
+        not wait for a duplicate QC broadcast or a controller resync.  A
+        1-validator net produces each QC exactly once, so without the
+        retry timer the first two failures would wedge the chain."""
+        async def main():
+            net = SimNetwork(n_validators=1, block_interval_ms=20)
+            adapter = net.nodes[0].adapter
+            real_commit = adapter.commit
+            failures = {"left": 2, "seen": 0}
+
+            async def flaky_commit(height, commit):
+                failures["seen"] += 1
+                if failures["left"] > 0:
+                    failures["left"] -= 1
+                    raise RuntimeError("controller transiently down")
+                return await real_commit(height, commit)
+
+            adapter.commit = flaky_commit
+            net.start(init_height=1)
+            await net.run_until_height(2, timeout=30)
+            assert failures["seen"] >= 3  # 2 failures + ≥1 success
+            await net.stop()
+        run(main())
+
+
 class TestWalSemantics:
     def test_no_revote_after_restart(self):
         """A restarted node must not re-vote in a round it already voted in

@@ -75,6 +75,27 @@ class TestArithmetic:
         assert F.to_int(F.add(x, x)) == 2 * v % P
         assert F.to_int(F.sub(x, x)) == 0
 
+    def test_mul_formulations_agree(self, monkeypatch):
+        """Both convolution formulations (staircase: CPU compile-speed
+        path; padsum: the TPU runtime path) must stay bit-equivalent to
+        each other AND to big-int math — on CPU CI the auto-select only
+        ever traces staircase, so without this the padsum branch the
+        production chip executes would have zero coverage."""
+        vals = rand_elems(8)
+        ws = rand_elems(8)
+        x = jnp.asarray(np.stack([F.from_int(v) for v in vals]))
+        y = jnp.asarray(np.stack([F.from_int(w) for w in ws]))
+        outs = {}
+        for form in ("staircase", "padsum"):
+            monkeypatch.setenv("CONSENSUS_FIELD_MUL", form)
+            outs[form] = np.asarray(F.strict(F.mul(x, y)))
+        assert np.array_equal(outs["staircase"], outs["padsum"])
+        got = F.ints_from_strict(outs["padsum"])
+        assert got == [v * w % P for v, w in zip(vals, ws)]
+        monkeypatch.setenv("CONSENSUS_FIELD_MUL", "typo")
+        with pytest.raises(ValueError):
+            F.mul(x, y)
+
     def test_mul_small(self):
         a = rand_elems(8)
         xa = jnp.asarray(F.from_ints(a))

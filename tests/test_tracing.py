@@ -91,6 +91,49 @@ async def _serve(impl, interceptors=()):
     return server, port
 
 
+class TestEngineSpans:
+    def test_consensus_lifecycle_spans_on_wire(self):
+        """The engine's own spans (VERDICT r3 item 7): running a
+        1-validator net with a tracer must ship consensus.height /
+        consensus.round / consensus.qc_verify spans to the agent socket —
+        the round lifecycle the reference #[instrument]s
+        (src/consensus.rs:96,143,209)."""
+
+        async def main():
+            from consensus_overlord_tpu.sim import SimNetwork
+
+            sock, udp_port = udp_listener()
+            exporter = JaegerExporter(f"127.0.0.1:{udp_port}", "consensus",
+                                      linger_s=0.02)
+            net = SimNetwork(n_validators=4, block_interval_ms=20)
+            for node in net.nodes:
+                node.engine.tracer = exporter
+            net.start(init_height=1)
+            await net.run_until_height(3)
+            await net.stop()
+            exporter.close()
+
+            loop = asyncio.get_running_loop()
+            seen = b""
+            for _ in range(16):
+                try:
+                    data, _ = await loop.run_in_executor(
+                        None, lambda: sock.recvfrom(65536))
+                except socket.timeout:
+                    break
+                seen += data
+                if (b"consensus.height" in seen
+                        and b"consensus.round" in seen
+                        and b"consensus.qc_verify" in seen):
+                    break
+            sock.close()
+            assert b"consensus.round" in seen
+            assert b"consensus.height" in seen
+            assert b"consensus.qc_verify" in seen
+
+        asyncio.run(main())
+
+
 class TestPropagation:
     def test_trace_spans_and_outbound_injection(self):
         """inbound traceparent → server span exported with that trace id
