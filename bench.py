@@ -32,8 +32,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # verifies/s).  Override with BENCH_N for other points.
 N = int(os.environ.get("BENCH_N", "8192"))       # votes per round-batch
 ITERS = int(os.environ.get("BENCH_ITERS", "2"))  # timed iterations
+#: Distinct message hashes per batch.  1 = the single-hash best case
+#: (all votes on one block); 3 = the realistic mixed frontier batch
+#: (votes + proposal + choke traffic) through the fused k-group kernel
+#: (tpu_provider.verify_round_multi).  The driver runs the default; the
+#: k=3 row is recorded in BASELINE.md.
+HASHES = int(os.environ.get("BENCH_HASHES", "1"))
 CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".bench_fixture.npz")
+                     f".bench_fixture{'' if HASHES == 1 else HASHES}.npz")
 
 #: BASELINE.md "blst-equivalent single-thread verify rate" — the honest
 #: external bar (round 1 compared against the pure-Python oracle, which
@@ -42,27 +48,30 @@ BLST_EQUIV_CPU_RATE = 1400.0
 
 
 def _fixture():
-    """N (sig, pubkey) pairs on one message hash; disk-cached because host
-    signing is the slow part of setup, not the thing under test."""
+    """N (sig, hash, pubkey) triples over HASHES distinct message hashes
+    (lane i signs hash i mod HASHES); disk-cached because host signing is
+    the slow part of setup, not the thing under test."""
     import numpy as np
 
     from consensus_overlord_tpu.core.sm3 import sm3_hash
     from consensus_overlord_tpu.crypto import bls12381 as oracle
 
-    h = sm3_hash(b"bench-block-hash")
+    hs = [sm3_hash(b"bench-block-hash" if g == 0
+                   else b"bench-block-hash-%d" % g) for g in range(HASHES)]
+    hashes = [hs[i % HASHES] for i in range(N)]
     if os.path.exists(CACHE):
         data = np.load(CACHE)
         if data["sigs"].shape[0] >= N:  # slice a larger cache, keep it
             sigs = [bytes(r) for r in data["sigs"][:N]]
             pks = [bytes(r) for r in data["pks"][:N]]
-            return sigs, h, pks
+            return sigs, hashes, pks
     sks = [0xBEEF + 97 * i for i in range(N)]
-    sigs = [oracle.sign(sk, h) for sk in sks]
+    sigs = [oracle.sign(sk, hashes[i]) for i, sk in enumerate(sks)]
     pks = [oracle.sk_to_pk(sk) for sk in sks]
     np.savez(CACHE,
              sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N, 48),
              pks=np.frombuffer(b"".join(pks), np.uint8).reshape(N, 96))
-    return sigs, h, pks
+    return sigs, hashes, pks
 
 
 def main():
@@ -73,11 +82,11 @@ def main():
     from consensus_overlord_tpu.crypto import native
     from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
 
-    sigs, h, pks = _fixture()
+    sigs, hashes, pks = _fixture()
+    h = hashes[0]
 
     provider = TpuBlsCrypto(0xA11CE)
     provider.update_pubkeys(pks)          # per-reconfigure cost, not per-round
-    hashes = [h] * N
 
     # Warmup: compile + one correctness pass.
     result = provider.verify_batch(sigs, hashes, pks)
@@ -114,7 +123,7 @@ def main():
     k = 8
     t0 = time.time()
     for i in range(k):
-        assert oracle.verify(pks[i], h, sigs[i])
+        assert oracle.verify(pks[i], hashes[i], sigs[i])
     cpu_best = k / (time.time() - t0)
     cpu_key = ("cpu_native_verifies_per_s" if native.available()
                else "cpu_pure_python_verifies_per_s")
@@ -129,7 +138,7 @@ def main():
         pure = 1 / (time.time() - t0)
     print(json.dumps({
         "context": {
-            "batch": N, "iters": ITERS,
+            "batch": N, "iters": ITERS, "distinct_hashes": HASHES,
             "sync_verifies_per_s": round(sync_rate, 2),
             "pipelined_verifies_per_s": round(rate, 2),
             cpu_key: round(cpu_best, 2),

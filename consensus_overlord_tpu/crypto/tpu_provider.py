@@ -39,6 +39,7 @@ Signing keys stay host-side (SURVEY.md §7 hard part (e)).
 
 from __future__ import annotations
 
+import os
 import secrets
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -77,10 +78,18 @@ _GROUP_SIZES = (2, 4)
 
 
 def _pad_to(n: int) -> int:
+    # CONSENSUS_PAD_MIN pins the bottom of the pad ladder: every batch
+    # pads to at least this rung, so a deployment compiles ONE kernel
+    # shape instead of one per rung the traffic happens to hit.  Worth
+    # real money when cold compiles are expensive (a fresh rung through
+    # the remote-compile relay can cost tens of minutes) and the rung's
+    # runtime cost is flat (an 8-lane and a 32-lane batch cost the same
+    # dispatch).
+    floor = int(os.environ.get("CONSENSUS_PAD_MIN", "0"))
     for s in _PAD_SIZES:
-        if n <= s:
+        if n <= s and floor <= s:
             return s
-    return -(-n // _PAD_SIZES[-1]) * _PAD_SIZES[-1]
+    return -(-max(n, floor) // _PAD_SIZES[-1]) * _PAD_SIZES[-1]
 
 
 def _pk_capacity(n: int) -> int:
@@ -242,13 +251,26 @@ class TpuBlsCrypto:
     """
 
     def __init__(self, private_key: int, common_ref: bytes = b"",
-                 device_threshold: int = 32, mesh=None):
+                 device_threshold: int = 32, mesh=None,
+                 qc_device_threshold: Optional[int] = None):
         """mesh: optional jax.sharding.Mesh — batches then shard across its
         devices through the parallel/sharded.py kernels (single-chip jits
-        otherwise).  Pass parallel.make_mesh() to use every local device."""
+        otherwise).  Pass parallel.make_mesh() to use every local device.
+
+        qc_device_threshold: separate device threshold for the QC paths
+        (aggregate_signatures / verify_aggregated / pubkey validation);
+        defaults to device_threshold.  The economics differ: a QC
+        aggregate-verify costs the host ONE decompress + N point adds +
+        2 pairings (~100 ms total), while N per-signature verifies cost
+        ~100 ms EACH — so small fleets often want verifies on device
+        but QC work on host (also: each path is its own kernel set, so
+        splitting the thresholds halves the compile surface)."""
         self._cpu = CpuBlsCrypto(private_key, common_ref)
         self._common_ref = common_ref
         self._threshold = device_threshold
+        self._qc_threshold = (qc_device_threshold
+                              if qc_device_threshold is not None
+                              else device_threshold)
         self._kernels = (_MeshKernels(mesh) if mesh is not None
                          and mesh.devices.size > 1 else _SingleChipKernels)
         # Validated-pubkey cache, stacked for vectorized batch gathers
@@ -307,7 +329,7 @@ class TpuBlsCrypto:
             raise CryptoError(
                 f"signatures x voters length mismatch "
                 f"{len(signatures)} x {len(voters)}")
-        if len(signatures) < self._threshold:
+        if len(signatures) < self._qc_threshold:
             return lambda: self._cpu.aggregate_signatures(signatures, voters)
         n = len(signatures)
         size = self._pad_to(n)
@@ -344,7 +366,7 @@ class TpuBlsCrypto:
         """Dispatch the QC pubkey aggregation now (device gather from the
         resident cache); returns resolve() → bool finishing host-side
         (signature decompress + 2 pairings)."""
-        if len(voters) < self._threshold:
+        if len(voters) < self._qc_threshold:
             return lambda: self._cpu.verify_aggregated_signature(
                 agg_sig, hash32, voters)
         idx = self._pk_rows_of(voters)
@@ -606,10 +628,10 @@ class TpuBlsCrypto:
         n = len(voters)
         if n == 0:
             return
-        if n < self._threshold:
+        if n < self._qc_threshold:
             # Small reconfigure (e.g. a 4-validator net): host validation
             # is cheaper than a device dispatch round-trip — the same
-            # threshold economics as the verify paths.
+            # threshold economics as the QC paths.
             self._update_pubkeys_host(voters)
             return
         size = self._pad_to(n)

@@ -1,0 +1,99 @@
+"""Device-kernel prewarming, shared by every entry point that measures
+or serves traffic (scripts/warm_cache.py, sim/run.py --prewarm,
+scripts/sim_multichain.py).
+
+Two facts of the deployment environment make this module exist:
+
+* First touch of a kernel in a process costs 20-150 s EVEN ON A
+  PERSISTENT-CACHE HIT when the device sits behind a remote PJRT
+  tunnel (the serialized executable ships over the link); a cold
+  compile through the tunnel's remote_compile endpoint can cost tens
+  of minutes.  Warming moves that one-time cost out of consensus
+  rounds and measured heights.
+
+* The remote_compile endpoint can drop the connection mid-compile
+  ("response body closed before all bytes were read"); the compile
+  server keeps partial progress, so a retry usually completes.  Every
+  warming step therefore runs under retry() — one flaky drop must not
+  abort a fleet run right before its measured heights.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Sequence
+
+logger = logging.getLogger("consensus_overlord_tpu.warm")
+
+
+def retry(label: str, fn, attempts: int = 3):
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — warming must be resilient
+            if i + 1 == attempts:
+                raise
+            logger.warning("%s: attempt %d failed (%s); retrying",
+                           label, i + 1, e)
+            time.sleep(5.0)
+
+
+def rungs_for(max_batch: int) -> List[int]:
+    """Every pad-ladder rung a fleet coalescing batches up to
+    `max_batch` lanes can hit (CONSENSUS_PAD_MIN collapses the low
+    rungs — _pad_to applies it, so duplicates are filtered here)."""
+    from .tpu_provider import _pad_to
+    top = _pad_to(max_batch)
+    seen: List[int] = []
+    for n in (8, 32, 128, 512, 1024, 2048, 8192):
+        r = _pad_to(min(n, max_batch))
+        if r not in seen:
+            seen.append(r)
+        if r >= top:
+            break
+    return seen
+
+
+def warm_bls(provider, rungs: Sequence[int],
+             group_sizes: Sequence[int] = (1, 2, 4)) -> None:
+    """Load/compile every BLS device kernel path a fleet uses at each
+    rung: pubkey validation, single- and k-hash fused verify, signature
+    aggregation, QC aggregate-verify."""
+    from ..core.sm3 import sm3_hash
+    from . import bls12381 as oracle
+
+    top = max(rungs)
+    hs = [sm3_hash(b"warm-%d" % g) for g in range(max(group_sizes))]
+    sks = list(range(88000, 88000 + top))
+    pks = [oracle.sk_to_pk(sk) for sk in sks]
+    retry("warm update_pubkeys", lambda: provider.update_pubkeys(pks))
+    for rung in rungs:
+        n = rung
+        for k in group_sizes:
+            lane_h = [hs[i % k] for i in range(n)]
+            sigs = [oracle.sign(sk, lane_h[i])
+                    for i, sk in enumerate(sks[:n])]
+            assert all(retry(
+                f"warm rung {rung} {k}-hash",
+                lambda s=sigs, lh=lane_h: provider.verify_batch(
+                    s, lh, pks[:n])))
+        sigs = [oracle.sign(sk, hs[0]) for sk in sks[:n]]
+        agg = retry(f"warm rung {rung} aggregate",
+                    lambda s=sigs: provider.aggregate_signatures(
+                        s, pks[:n]))
+        assert retry(f"warm rung {rung} qc-verify",
+                     lambda a=agg: provider.verify_aggregated_signature(
+                         a, hs[0], pks[:n]))
+
+
+def warm_simple(provider, rungs: Sequence[int]) -> None:
+    """Load/compile the single batched-verify kernel of the one-kernel
+    providers (secp256k1 / SM2 / Ed25519) at each rung."""
+    h = provider.hash(b"warm")
+    sig = provider.sign(h)
+    for rung in rungs:
+        assert all(retry(
+            f"warm rung {rung} verify",
+            lambda n=rung: provider.verify_batch(
+                [sig] * n, [h] * n, [provider.pub_key] * n)))

@@ -33,7 +33,23 @@ def main() -> None:
                         "frontier (always on with --tpu: the device path "
                         "needs coalesced batches + off-loop dispatch)")
     parser.add_argument("--frontier-linger-ms", type=float, default=2.0)
+    parser.add_argument("--device-threshold", type=int, default=8,
+                        help="batch size at which --tpu providers ship "
+                        "work to the device instead of the host oracle "
+                        "(host single verify ≈ 100 ms vs ~200 ms device "
+                        "round-trip for ANY batch — small fleets want "
+                        "this low so coalesced batches actually ride "
+                        "the chip)")
     parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("--prewarm", action="store_true",
+                        help="run one dummy batch through every device "
+                        "kernel path BEFORE starting the fleet.  First "
+                        "touch of a kernel costs 20-150 s per kernel "
+                        "even on a persistent-cache hit (the serialized "
+                        "executable ships over the remote PJRT tunnel); "
+                        "prewarming moves that one-time cost out of the "
+                        "measured heights, which otherwise time out "
+                        "behind it")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args()
 
@@ -50,7 +66,8 @@ def main() -> None:
             # threshold 8: batches actually reach the device even in
             # small fleets, keeping the reported "tpu" field truthful
             factory = lambda i: TpuBlsCrypto(  # noqa: E731
-                0x1000 + 7919 * i, device_threshold=8)
+                0x1000 + 7919 * i,
+                device_threshold=args.device_threshold)
         else:
             from ..crypto.provider import CpuBlsCrypto
 
@@ -64,16 +81,35 @@ def main() -> None:
         # otherwise keep every verify on the host so the reported "tpu"
         # field is truthful (the provider would silently engage the
         # device past its default threshold).
-        thresh = 8 if args.tpu else 10**9
+        thresh = args.device_threshold if args.tpu else 10**9
         factory = lambda i: cls(base + 7919 * i,  # noqa: E731
                                 device_threshold=thresh)
     elif args.tpu:
         from ..crypto.ed25519_tpu import Ed25519TpuCrypto
 
         factory = lambda i: Ed25519TpuCrypto(  # noqa: E731
-            (0x4000 + 7919 * i).to_bytes(32, "big"), device_threshold=8)
+            (0x4000 + 7919 * i).to_bytes(32, "big"),
+            device_threshold=args.device_threshold)
     else:
         factory = None
+
+    if args.prewarm and args.tpu:
+        import time as _t
+
+        from ..crypto.warm import rungs_for, warm_bls, warm_simple
+
+        t0 = _t.time()
+        warm = factory(10**6)  # same thresholds as the fleet's providers
+        # Warm every rung the fleet's coalesced batches can hit: up to
+        # ~validators lanes per batch (the leader sees N-1 votes), and
+        # at least the device threshold.
+        rungs = rungs_for(max(args.device_threshold, args.validators, 8))
+        if args.crypto == "bls":
+            warm_bls(warm, rungs)
+        else:
+            warm_simple(warm, rungs)
+        print(f"prewarm: device kernel paths loaded for rungs {rungs} "
+              f"in {_t.time() - t0:.1f}s")
 
     async def run() -> dict:
         net = SimNetwork(n_validators=args.validators,

@@ -1,0 +1,112 @@
+"""Config-5 exercise (BASELINE.json): mixed-curve multi-chain — two
+independent consensus fleets with DIFFERENT signature schemes running
+concurrently in one process, sharing one TPU through their providers'
+frontiers (the multi-chain shape CITA-Cloud deployments run, one
+consensus service per chain; reference SURVEY.md §0).
+
+Chain A: SM2 validators with the device-batched provider (the scheme
+CITA-Cloud mainnets actually deploy).  Chain B: Ed25519 validators on
+the host path (its device dispatch costs ~0.8 s/batch, so below
+~64-lane coalesced batches the host C backend wins — that crossover is
+the provider's own device_threshold default, and honesty beats forcing
+traffic onto the chip).
+
+Prints one JSON line per chain plus a combined line.
+
+Usage: python scripts/sim_multichain.py [--a-validators 32]
+       [--b-validators 64] [--heights 3] [--interval-ms 3000]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--a-validators", type=int, default=32)
+    ap.add_argument("--b-validators", type=int, default=64)
+    ap.add_argument("--heights", type=int, default=3)
+    ap.add_argument("--interval-ms", type=int, default=3000)
+    ap.add_argument("--device-threshold", type=int, default=4)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    args = ap.parse_args()
+
+    os.environ.setdefault("CONSENSUS_PAD_MIN", "32")
+
+    from consensus_overlord_tpu.crypto.ecdsa_tpu import Sm2Crypto
+    from consensus_overlord_tpu.crypto.provider import Ed25519Crypto
+    from consensus_overlord_tpu.sim import SimNetwork
+
+    # Prewarm the SM2 device kernel (first touch through the remote
+    # tunnel costs ~30 s; retried via crypto/warm.py against the flaky
+    # remote_compile endpoint).
+    from consensus_overlord_tpu.crypto.warm import rungs_for, warm_simple
+    warm = Sm2Crypto(0x7777, device_threshold=args.device_threshold)
+    warm_simple(warm, rungs_for(max(args.device_threshold,
+                                    args.a_validators, 8)))
+
+    async def run_chain(name, net, heights, timeout):
+        t0 = time.perf_counter()
+        last = t0
+        ms = []
+        for h in range(1, heights + 1):
+            await net.run_until_height(h, timeout=timeout)
+            now = time.perf_counter()
+            ms.append((now - last) * 1000)
+            last = now
+        total = time.perf_counter() - t0
+        await net.stop()
+        srt = sorted(ms)
+        return {
+            "chain": name,
+            "validators": len(net.nodes),
+            "heights": heights,
+            "total_s": round(total, 3),
+            "p50_ms": round(srt[len(srt) // 2], 1),
+            "p95_ms": round(srt[-1], 1),
+            "delivered": net.router.delivered,
+        }
+
+    async def run() -> None:
+        a = SimNetwork(
+            n_validators=args.a_validators,
+            block_interval_ms=args.interval_ms,
+            crypto_factory=lambda i: Sm2Crypto(
+                0x3000 + 7919 * i,
+                device_threshold=args.device_threshold),
+            use_frontier=True, frontier_linger_s=0.05)
+        b = SimNetwork(
+            n_validators=args.b_validators,
+            block_interval_ms=args.interval_ms,
+            crypto_factory=lambda i: Ed25519Crypto(
+                (0x5000 + 7919 * i).to_bytes(4, "big") * 8),
+            use_frontier=True, frontier_linger_s=0.005)
+        t0 = time.perf_counter()
+        a.start(init_height=1)
+        b.start(init_height=1)
+        ra, rb = await asyncio.gather(
+            run_chain("sm2-device", a, args.heights, args.timeout),
+            run_chain("ed25519-host", b, args.heights, args.timeout))
+        wall = time.perf_counter() - t0
+        print(json.dumps({**ra, "crypto": "sm2", "tpu": True}))
+        print(json.dumps({**rb, "crypto": "ed25519", "tpu": False}))
+        print(json.dumps({
+            "metric": "multi-chain-mixed-curve",
+            "chains": 2,
+            "total_validators": args.a_validators + args.b_validators,
+            "heights_per_chain": args.heights,
+            "wall_s": round(wall, 3),
+        }))
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

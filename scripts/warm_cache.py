@@ -23,37 +23,15 @@ def main() -> None:
     from consensus_overlord_tpu.compile_cache import enable
     enable()
 
-    from consensus_overlord_tpu.core.sm3 import sm3_hash
-    from consensus_overlord_tpu.crypto import bls12381 as oracle
     from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+    from consensus_overlord_tpu.crypto.warm import warm_bls
 
     rungs = [int(a) for a in sys.argv[1:]] or [32, 128, 512]
     provider = TpuBlsCrypto(0xFACE, device_threshold=1)
-    top = max(rungs)
-    sks = [4242 + 31 * i for i in range(top)]
-    hs = [sm3_hash(b"warm-%d" % g) for g in range(4)]
-    sigs = {h: [oracle.sign(sk, h) for sk in sks] for h in hs}
-    pks = [oracle.sk_to_pk(sk) for sk in sks]
-    provider.update_pubkeys(pks)  # g2_validate at the pubkey rung
-
     for rung in rungs:
-        n = rung  # exact rung size (pads to itself)
         t0 = time.time()
-        assert all(provider.verify_batch(sigs[hs[0]][:n], [hs[0]] * n,
-                                         pks[:n]))
-        print(f"rung {rung}: single-hash {time.time() - t0:.1f}s",
-              flush=True)
-        for k in (2, 4):
-            t0 = time.time()
-            lane_h = [hs[i % k] for i in range(n)]
-            batch = [sigs[lane_h[i]][i] for i in range(n)]
-            assert all(provider.verify_batch(batch, lane_h, pks[:n]))
-            print(f"rung {rung}: {k}-hash {time.time() - t0:.1f}s",
-                  flush=True)
-        t0 = time.time()
-        agg = provider.aggregate_signatures(sigs[hs[0]][:n], pks[:n])
-        assert provider.verify_aggregated_signature(agg, hs[0], pks[:n])
-        print(f"rung {rung}: aggregate+QC {time.time() - t0:.1f}s",
+        warm_bls(provider, [rung])
+        print(f"rung {rung}: warmed in {time.time() - t0:.1f}s",
               flush=True)
 
 

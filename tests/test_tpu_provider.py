@@ -220,3 +220,38 @@ class TestAsyncPipeline:
         r_bad = tpu.verify_aggregated_async(agg, sm3_hash(b"no"), voters)
         assert r_ok() is True
         assert r_bad() is False
+
+
+class TestThresholdKnobs:
+    def test_pad_min_floor(self, monkeypatch):
+        """CONSENSUS_PAD_MIN pins the bottom of the pad ladder so a
+        deployment compiles one kernel shape (cold compiles through the
+        remote relay cost tens of minutes per rung)."""
+        from consensus_overlord_tpu.crypto.tpu_provider import _pad_to
+        monkeypatch.delenv("CONSENSUS_PAD_MIN", raising=False)
+        assert _pad_to(5) == 8
+        assert _pad_to(33) == 128
+        monkeypatch.setenv("CONSENSUS_PAD_MIN", "32")
+        assert _pad_to(5) == 32
+        assert _pad_to(32) == 32
+        assert _pad_to(33) == 128
+        monkeypatch.setenv("CONSENSUS_PAD_MIN", "8192")
+        assert _pad_to(5) == 8192
+        monkeypatch.setenv("CONSENSUS_PAD_MIN", "9000")
+        assert _pad_to(5) == 16384  # above the ladder: multiple of top
+
+    def test_qc_threshold_splits_paths(self, cpus):
+        """qc_device_threshold routes the QC paths (aggregate / verify
+        aggregated / pubkey validation) independently of the verify
+        threshold — small fleets want verifies batched on device but QC
+        work on the host (one decompress + N adds + 2 pairings)."""
+        t = TpuBlsCrypto(KEYS[0], device_threshold=1,
+                         qc_device_threshold=10**9)
+        t.update_pubkeys([c.pub_key for c in cpus])  # host-validated
+        sigs, hashes, voters = make_votes(cpus, b"split-thresh")
+        # verify path: device (threshold 1); QC paths: host (threshold inf)
+        assert t.verify_batch(sigs, hashes, voters) == [True] * N
+        agg = t.aggregate_signatures(sigs, voters)
+        assert agg == CpuBlsCrypto(KEYS[0]).aggregate_signatures(
+            sigs, voters)
+        assert t.verify_aggregated_signature(agg, hashes[0], voters)
