@@ -74,7 +74,12 @@ _PK_CAPS = (256, 1024, 4096, 16384)
 # Fused multi-hash kernel group-count ladder: mixed vote+proposal+choke
 # frontier batches carry ≤3 distinct hashes; k pads to one of these and
 # larger hash counts split into pipelined single-hash sub-batches.
-_GROUP_SIZES = (2, 4)
+# k=3 has its own rung (r4): the common vote+proposal+choke mix was
+# padding to 4 and paying a full G2 MSM for an always-empty group.
+# Expected ~+25% for 3-hash batches from the MSM count (1 G1 + 3 G2 vs
+# 1 + 4; the measured 3-vs-4-group delta at N=8192 is still pending —
+# the k=3 kernel's first tunnel compile outlived round 4's clock).
+_GROUP_SIZES = (2, 3, 4)
 
 
 def _pad_to(n: int) -> int:

@@ -56,12 +56,19 @@ def rungs_for(max_batch: int) -> List[int]:
 
 
 def warm_bls(provider, rungs: Sequence[int],
-             group_sizes: Sequence[int] = (1, 2, 4)) -> None:
+             group_sizes: Sequence[int] | None = None) -> None:
     """Load/compile every BLS device kernel path a fleet uses at each
     rung: pubkey validation, single- and k-hash fused verify, signature
-    aggregation, QC aggregate-verify."""
+    aggregation, QC aggregate-verify.  group_sizes defaults to 1 + the
+    provider's full multi-hash ladder (derived, so a ladder change
+    can't silently leave a rung unwarmed and push its first-touch
+    compile into live consensus rounds)."""
     from ..core.sm3 import sm3_hash
     from . import bls12381 as oracle
+    from .tpu_provider import _GROUP_SIZES
+
+    if group_sizes is None:
+        group_sizes = (1,) + tuple(_GROUP_SIZES)
 
     top = max(rungs)
     hs = [sm3_hash(b"warm-%d" % g) for g in range(max(group_sizes))]
