@@ -15,7 +15,7 @@ FROM python:3.11-slim
 RUN useradd -m chain
 WORKDIR /home/chain
 COPY --from=build /build/dist/*.whl /tmp/
-RUN pip install --no-cache-dir /tmp/*.whl "jax[cpu]" grpcio protobuf \
+RUN pip install --no-cache-dir /tmp/*.whl "jax[cpu]" filelock grpcio protobuf \
         prometheus-client && rm /tmp/*.whl
 # grpc health probing (reference Dockerfile:16) — the Health service is
 # standard, so any grpc-health-probe binary works; ship a python probe so

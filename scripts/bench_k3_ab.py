@@ -1,0 +1,91 @@
+"""A/B the k=3 fused-kernel rung against padding 3-hash batches to k=4.
+
+The r4 commit 349fab5 added a dedicated 3-group rung to the fused
+multi-hash verify ladder (tpu_provider._GROUP_SIZES), justified by MSM
+op count alone (1 G1 + 3 G2 MSMs vs 1 + 4, expected ~+25%) — the exact
+style of reasoning that measured wrong three times in this project
+(Pippenger r3, staircase r4, G2 tables r4).  This script supplies the
+measurement: interleaved A/B of the SAME 3-distinct-hash batch stream
+through the k=3 kernel vs the k=4 kernel (same provider, same pubkey
+cache, same day), pipelined at the production depth.
+
+Per the BASELINE.md r3 honesty note, the remote PJRT relay dedupes
+repeated identical computations — defeated here (as in bench.py) by the
+fresh per-call RLC weights verify_batch draws internally.
+
+Usage: python scripts/bench_k3_ab.py [N] [segments]
+Prints per-segment rates and the final k=3/k=4 throughput ratio.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+SEGMENTS = int(sys.argv[2]) if len(sys.argv) > 2 else 3  # per arm
+DEPTH = int(os.environ.get("BENCH_DEPTH", "8"))
+DISPATCHES = 3 * DEPTH  # sustained-pipeline dispatch count (bench.py r4)
+
+
+def run_segment(provider, sigs, hashes, pks):
+    t0 = time.time()
+    inflight = []
+    done = 0
+    ok = True
+    for _ in range(DISPATCHES):
+        inflight.append(provider.verify_batch_async(sigs, hashes, pks))
+        if len(inflight) >= DEPTH:
+            ok &= all(inflight.pop(0)())
+            done += 1
+    while inflight:
+        ok &= all(inflight.pop(0)())
+        done += 1
+    rate = N * done / (time.time() - t0)
+    assert ok, "batch failed verification"
+    return rate
+
+
+def main():
+    from consensus_overlord_tpu.compile_cache import enable
+    enable()
+
+    os.environ["BENCH_HASHES"] = "3"  # before import: bench derives
+    import bench                      # HASHES and the fixture name from it
+    from consensus_overlord_tpu.crypto import tpu_provider as tp
+
+    bench.N = N
+    sigs, hashes, pks = bench._fixture()
+    assert len({bytes(h) for h in hashes}) == 3
+
+    provider = tp.TpuBlsCrypto(0xA11CE)
+    provider.update_pubkeys(pks)
+
+    arms = {"k3": (2, 3, 4), "k4": (2, 4)}
+    # Warm both kernels (compile) before any timing.
+    for name, sizes in arms.items():
+        tp._GROUP_SIZES = sizes
+        t0 = time.time()
+        assert all(provider.verify_batch(sigs, hashes, pks))
+        print(f"warm {name}: {time.time() - t0:.1f}s", flush=True)
+
+    rates = {"k3": [], "k4": []}
+    for seg in range(SEGMENTS):
+        for name, sizes in arms.items():
+            tp._GROUP_SIZES = sizes
+            r = run_segment(provider, sigs, hashes, pks)
+            rates[name].append(r)
+            print(f"seg {seg} {name}: {r:,.0f} verifies/s", flush=True)
+
+    best3, best4 = max(rates["k3"]), max(rates["k4"])
+    med3 = sorted(rates["k3"])[len(rates["k3"]) // 2]
+    med4 = sorted(rates["k4"])[len(rates["k4"]) // 2]
+    print(f"k3 best/median: {best3:,.0f} / {med3:,.0f}", flush=True)
+    print(f"k4 best/median: {best4:,.0f} / {med4:,.0f}", flush=True)
+    print(f"k3/k4 median ratio: {med3 / med4:.3f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()

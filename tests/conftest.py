@@ -24,12 +24,13 @@ import jax  # noqa: E402  (after XLA_FLAGS so the CPU backend sees it)
 
 jax.config.update("jax_platforms", "cpu")
 
-# The crypto kernels are big graphs (multi-hundred-iteration scans of
-# field ops); persistent compilation caching makes re-runs cheap.
-_CACHE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
-os.makedirs(_CACHE_DIR, exist_ok=True)
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The crypto kernels are big graphs (multi-hundred-iteration scans of
+# field ops); persistent compilation caching makes re-runs cheap.  Must
+# go through enable() — it owns the cache layout (host-fingerprinted
+# namespaces); a second hand-rolled config here would write entries at
+# the flat root, where enable()'s legacy prune deletes them.
+from consensus_overlord_tpu.compile_cache import enable as _enable  # noqa: E402
+
+_enable()
