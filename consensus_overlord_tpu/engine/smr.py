@@ -167,13 +167,22 @@ class _WalState:
 
 @dataclass
 class _VoteSet:
-    """Votes collected by the round leader, bucketed by block hash."""
+    """Votes collected by the round leader, bucketed by block hash.
+
+    Weight accumulates as votes arrive: the quorum test runs once PER
+    VOTE in the O(N) leader stream, so a recomputed sum there is O(N²)
+    across a round — 10k validators would spend whole seconds summing
+    weights (measured by scripts/bench_round.py's 10k flood)."""
 
     by_hash: Dict[Hash, Dict[Address, bytes]] = field(default_factory=dict)
+    weight_by_hash: Dict[Hash, int] = field(default_factory=dict)
     qc_sent: bool = False
 
-    def add(self, block_hash: Hash, voter: Address, sig: bytes) -> None:
+    def add(self, block_hash: Hash, voter: Address, sig: bytes,
+            weight: int) -> None:
         self.by_hash.setdefault(block_hash, {})[voter] = sig
+        self.weight_by_hash[block_hash] = (
+            self.weight_by_hash.get(block_hash, 0) + weight)
 
 
 class Engine:
@@ -242,7 +251,13 @@ class Engine:
         self._precommits: Dict[int, _VoteSet] = {}
         self._prevote_qcs: Dict[int, AggregatedVote] = {}
         self._chokes: Dict[int, Dict[Address, bytes]] = {}
+        self._choke_weight: Dict[int, int] = {}  # accumulated, per round
         self._choke_rounds: Dict[Address, int] = {}  # highest choke round seen
+        #: Weight histogram over each validator's HIGHEST choke round —
+        #: the round-skip test sums it suffix-wise over ≤ROUND_WINDOW
+        #: buckets instead of scanning all N _choke_rounds entries per
+        #: inbound choke (O(N²) under a choke storm otherwise).
+        self._choke_round_hist: Dict[int, int] = {}
         self._my_prevote_round: Optional[int] = None
         self._my_precommit_round: Optional[int] = None
         self._committing = False
@@ -428,7 +443,9 @@ class Engine:
         self._precommits.clear()
         self._prevote_qcs.clear()
         self._chokes.clear()
+        self._choke_weight.clear()
         self._choke_rounds.clear()
+        self._choke_round_hist.clear()
         self._my_prevote_round = None
         self._my_precommit_round = None
         self._committing = False
@@ -476,7 +493,8 @@ class Engine:
         # (memory stays O(ROUND_WINDOW) regardless of round spray).
         floor = round_ - self.ROUND_WINDOW
         for rounds_map in (self._prevotes, self._precommits, self._chokes,
-                           self._prevote_qcs, self._proposals):
+                           self._choke_weight, self._prevote_qcs,
+                           self._proposals):
             for r in [r for r in rounds_map if r < floor]:
                 del rounds_map[r]
         await self._save_wal()
@@ -810,13 +828,16 @@ class Engine:
             logger.warning("%s: bad vote signature from %s", self._tag(),
                            sv.voter[:4].hex())
             return
-        vote_set.add(v.block_hash, sv.voter, sv.signature)
+        vote_set.add(v.block_hash, sv.voter, sv.signature,
+                     self._weight_map.get(sv.voter, 0))
         await self._try_aggregate(v.vote_type, v.round, v.block_hash, vote_set)
 
     async def _try_aggregate(self, vote_type: VoteType, round_: int,
                              block_hash: Hash, vote_set: _VoteSet) -> None:
         votes = vote_set.by_hash.get(block_hash, {})
-        if self._weight_of(list(votes)) < quorum_weight(self._total_weight()):
+        # O(1) accumulated weight — this test runs per inbound vote.
+        if (vote_set.weight_by_hash.get(block_hash, 0)
+                < quorum_weight(self._total_weight())):
             return
         # Aggregate in sorted-voter order so the signature matches the
         # bitmap extraction order at every verifier.
@@ -957,9 +978,20 @@ class Engine:
             logger.warning("%s: bad choke signature", self._tag())
             return
         chokes[sc.address] = sc.signature
-        self._choke_rounds[sc.address] = max(
-            self._choke_rounds.get(sc.address, -1), c.round)
-        if self._weight_of(list(chokes)) >= quorum_weight(self._total_weight()) \
+        # O(1) accumulated choke weight per round (the quorum test runs
+        # per inbound choke; a recomputed sum is O(N²) under choke storms).
+        w = self._weight_map.get(sc.address, 0)
+        self._choke_weight[c.round] = self._choke_weight.get(c.round, 0) + w
+        prev = self._choke_rounds.get(sc.address)
+        if prev is None or c.round > prev:
+            if prev is not None:
+                self._choke_round_hist[prev] -= w
+                if self._choke_round_hist[prev] <= 0:
+                    del self._choke_round_hist[prev]
+            self._choke_round_hist[c.round] = (
+                self._choke_round_hist.get(c.round, 0) + w)
+            self._choke_rounds[sc.address] = c.round
+        if self._choke_weight[c.round] >= quorum_weight(self._total_weight()) \
                 and c.round >= self.round:
             self.adapter.report_view_change(
                 self.height, self.round, "TIMEOUT_BRAKE quorum")
@@ -967,18 +999,23 @@ class Engine:
             return
         # Round skip (liveness after partition heal): if f+1 weight is choking
         # in rounds above ours, the network has moved on — jump to the lowest
-        # such round and help choke it to quorum.
-        higher = sorted({r for r in self._choke_rounds.values()
-                         if r > self.round})
+        # such round and help choke it to quorum.  Weight-at-or-above is a
+        # suffix sum over the choke-round histogram: O(ROUND_WINDOW) per
+        # choke, independent of validator count (a per-choke scan of all N
+        # _choke_rounds entries is O(N²) under a 10k-validator storm).
+        higher = sorted((r for r in self._choke_round_hist
+                         if r > self.round), reverse=True)
         f_plus_1 = self._total_weight() // 3 + 1
-        for r in higher:
-            at_or_above = [v for v, cr in self._choke_rounds.items()
-                           if cr >= r]
-            if self._weight_of(at_or_above) >= f_plus_1:
-                self.adapter.report_view_change(
-                    self.height, self.round, f"round skip to {r}")
-                await self._enter_round(r)
-                break
+        suffix = 0
+        skip_to = None
+        for r in higher:  # descending: suffix accumulates weight ≥ r
+            suffix += self._choke_round_hist[r]
+            if suffix >= f_plus_1:
+                skip_to = r  # keep descending: the LOWEST qualifying round
+        if skip_to is not None:
+            self.adapter.report_view_change(
+                self.height, self.round, f"round skip to {skip_to}")
+            await self._enter_round(skip_to)
 
     async def _broadcast_choke(self) -> None:
         choke = Choke(self.height, self.round)

@@ -1,0 +1,185 @@
+"""The r4 verdict's named single-chip lever: elementwise lane ALIGNMENT.
+
+bench_field_radix.py (r4) measured the production field multiply at
+99.5 GMAC/s of useful conv MACs — ~47% of the chip's practical int32
+elementwise ceiling — and attributed the gap to padding: with the limb
+axis MINOR, every (B, 39) / (B, 77) op occupies a full 128-lane vector
+register row, wasting 70% / 40% of each tile.  The hypothesis here: put
+the BATCH on the lane axis (minor, 8192 = 64 full tiles) and the limb
+axis on sublanes (39 → 5 sublane-tiles, 4% pad), so op cost scales with
+the true limb width instead of rounding to 128.
+
+Measured chains (slope-timed dependent chains per bench_field_radix.py's
+honesty rules — fresh salt per call, one checksum download, per-step =
+(t(2K) − t(K)) / K so the ~120-200 ms PJRT-tunnel round-trip cancels):
+
+  1. FQ.mul, current (B, n) layout              [production baseline]
+  2. transposed (n, B) mul: same op sequence, conv + identical reduce
+     plan on axis -2; bit-identical outputs (asserted)
+  3. decomposition of 1: conv alone vs reduce alone (which half owns
+     the time decides where further levers live)
+
+Usage: python scripts/bench_limb_align.py [B] [K]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from consensus_overlord_tpu.compile_cache import enable
+
+enable()
+from consensus_overlord_tpu.ops.field import BLS12_381_FQ as FQ
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+ITERS = 6
+rng = np.random.default_rng(11)
+n = FQ.n
+b_bits = FQ.b
+mask = FQ.mask
+
+
+def timed(name, make_chain, *arrays, macs_per_step=None):
+    devs = [jnp.asarray(a) for a in arrays]
+
+    def median_call(fn):
+        ts = []
+        for i in range(ITERS + 1):
+            t0 = time.time()
+            jax.device_get(fn(*devs, jnp.int32(i)))
+            ts.append(time.time() - t0)
+        return sorted(ts[1:])[len(ts[1:]) // 2]
+
+    t1 = median_call(jax.jit(make_chain(K)))
+    t2 = median_call(jax.jit(make_chain(2 * K)))
+    per_step = max((t2 - t1) / K, 1e-9)
+    extra = ""
+    if macs_per_step:
+        extra = f"  ({macs_per_step / per_step / 1e9:6.1f} GMAC/s)"
+    print(f"  {name:<44s} {per_step * 1e6:9.1f} us/step{extra}"
+          f"   [K call {t1 * 1e3:.0f} ms, 2K {t2 * 1e3:.0f} ms]",
+          flush=True)
+    return per_step
+
+
+# -- transposed (limb-major, batch-minor) formulation -----------------------
+
+def reduce_T(x, bounds):
+    """FQ._reduce with the position axis at -2 — the identical statically
+    planned step sequence, so values match the production path bit for
+    bit."""
+    for step, arg in FQ._plan(list(bounds)):
+        if step == "pad":
+            x = jnp.concatenate(
+                [x, jnp.zeros(x.shape[:-2] + (arg, x.shape[-1]), jnp.int32)],
+                axis=-2)
+        elif step == "fold":
+            lo, hi = x[..., :n, :], x[..., n:, :]
+            x = lo + jnp.einsum("...kb,kj->...jb", hi, FQ._fold[:arg])
+        else:  # carry
+            if arg:
+                x = jnp.concatenate(
+                    [x, jnp.zeros(x.shape[:-2] + (1, x.shape[-1]),
+                                  jnp.int32)], axis=-2)
+            c = x >> b_bits
+            x = (x & mask) + jnp.concatenate(
+                [jnp.zeros(x.shape[:-2] + (1, x.shape[-1]), jnp.int32),
+                 c[..., :-1, :]], axis=-2)
+    return x
+
+
+def mul_T(x, y):
+    """Product convolution with limbs on axis -2, batch minor."""
+    terms = [
+        jnp.pad(x[..., i:i + 1, :] * y,
+                [(0, 0)] * (y.ndim - 2) + [(i, n - 1 - i), (0, 0)])
+        for i in range(n)
+    ]
+    out = terms[0]
+    for t in terms[1:]:
+        out = out + t
+    return reduce_T(out, FQ._conv_bounds())
+
+
+def main():
+    print(f"backend={jax.default_backend()} B={B} K={K} n={n}", flush=True)
+    yl = rng.integers(0, FQ.loose_max + 1, (B, n), dtype=np.int32)
+    fmac = B * n * n
+
+    # Bit-identical check first (CPU-cheap shapes).
+    xs = rng.integers(0, FQ.loose_max + 1, (256, n), dtype=np.int32)
+    ys = rng.integers(0, FQ.loose_max + 1, (256, n), dtype=np.int32)
+    a = jax.device_get(jax.jit(FQ.mul)(jnp.asarray(xs), jnp.asarray(ys)))
+    bt = jax.device_get(jax.jit(mul_T)(jnp.asarray(xs.T), jnp.asarray(ys.T)))
+    assert np.array_equal(a, bt.T), "transposed mul drifts from production"
+    print("  bit-identical: mul_T(x.T, y.T).T == FQ.mul(x, y)", flush=True)
+
+    def chain_cur(length):
+        def fn(y, salt):
+            def step(c, _):
+                return FQ.mul(c, y), None
+            c, _ = lax.scan(step, FQ.add(y, jnp.broadcast_to(salt, y.shape)),
+                            None, length=length)
+            return FQ.strict(c).sum()
+        return fn
+
+    def chain_T(length):
+        def fn(y, salt):
+            yT = y.T  # boundary transpose, amortized over the chain
+            def step(c, _):
+                return mul_T(c, yT), None
+            c, _ = lax.scan(step, yT + salt % 3, None, length=length)
+            return c.sum()
+        return fn
+
+    # conv-only / reduce-only decomposition (cost diagnostics, not field
+    # math: conv-only truncates + masks to stay bounded, reduce-only
+    # rebuilds a width-(2n-1) input from the running value).
+    def chain_conv(length):
+        def fn(y, salt):
+            def step(c, _):
+                terms = [
+                    jnp.pad(c[..., i:i + 1] * y,
+                            [(0, 0)] * (y.ndim - 1) + [(i, n - 1 - i)])
+                    for i in range(n)
+                ]
+                out = terms[0]
+                for t in terms[1:]:
+                    out = out + t
+                return out[..., :n] & mask, None
+            c, _ = lax.scan(step, y + salt % 3, None, length=length)
+            return c.sum()
+        return fn
+
+    def chain_reduce(length):
+        def fn(y, salt):
+            def step(c, _):
+                wide = jnp.concatenate([c, c[..., :n - 1]], axis=-1)
+                return FQ._reduce(wide, FQ._conv_bounds()), None
+            c, _ = lax.scan(step, (y + salt % 3) & mask, None, length=length)
+            return c.sum()
+        return fn
+
+    print(f"-- full field-mul chains, B={B} --", flush=True)
+    t_cur = timed("(B,n) limb-minor (production)", chain_cur, yl,
+                  macs_per_step=fmac)
+    t_T = timed("(n,B) limb-on-sublanes, batch-minor", chain_T, yl,
+                macs_per_step=fmac)
+    print(f"-- decomposition (current layout) --", flush=True)
+    t_cv = timed("conv only (trunc+mask)", chain_conv, yl, macs_per_step=fmac)
+    t_rd = timed("reduce only (rebuilt wide input)", chain_reduce, yl)
+    print("-- summary --", flush=True)
+    print(f"  transposed/current {t_T / t_cur:.2f}x  "
+          f"conv share ~{t_cv / t_cur:.2f}  reduce share ~{t_rd / t_cur:.2f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
