@@ -60,8 +60,10 @@ from . import bls12381 as oracle
 from .provider import CpuBlsCrypto, CryptoError
 
 # Batches are padded to the next size in this ladder so the number of
-# distinct jit specializations stays small.
-_PAD_SIZES = (8, 32, 128, 512, 1024, 2048, 8192)
+# distinct jit specializations stays small.  4096 was missing through r4
+# (a 4096-lane batch paid the 8192 kernel, 2x the MSM work); deployments
+# that want fewer rungs pin the floor with CONSENSUS_PAD_MIN instead.
+_PAD_SIZES = (8, 32, 128, 512, 1024, 2048, 4096, 8192)
 # Random-linear-combination weight width.  64-bit weights (the width
 # native blst uses for its batch verification) bound a forged batch's
 # acceptance at 2^-64 per attempt; the per-lane fallback then localizes,
@@ -98,6 +100,14 @@ def _pad_to(n: int) -> int:
 
 
 def _pk_capacity(n: int) -> int:
+    # CONSENSUS_PK_CAP_MIN pins the bottom of the capacity ladder, the
+    # same economics as CONSENSUS_PAD_MIN: the device pubkey cache's row
+    # capacity is part of every kernel's shape, so a deployment that
+    # knows its fleet ceiling compiles ONE kernel set instead of one per
+    # capacity rung its reconfigures happen to cross (16384 rows of G2
+    # coords ≈ 15 MB of HBM — capacity is cheap, compiles are not).
+    floor = int(os.environ.get("CONSENSUS_PK_CAP_MIN", "0"))
+    n = max(n, floor)
     for s in _PK_CAPS:
         if n <= s:
             return s

@@ -46,6 +46,10 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 os.environ.setdefault("CONSENSUS_PAD_MIN", "2048")
+# One pubkey-cache capacity across the 1k/4k/10k scales → the verify and
+# QC kernels keep ONE shape set (each fresh capacity is a full kernel
+# recompile, ~30-60 min through the remote-compile tunnel).
+os.environ.setdefault("CONSENSUS_PK_CAP_MIN", "16384")
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
 ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 20

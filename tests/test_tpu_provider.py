@@ -240,6 +240,28 @@ class TestThresholdKnobs:
         monkeypatch.setenv("CONSENSUS_PAD_MIN", "9000")
         assert _pad_to(5) == 16384  # above the ladder: multiple of top
 
+    def test_pad_ladder_has_4096_rung(self):
+        """A 4096-lane batch must not pay the 8192 kernel (2x the MSM
+        work — the rung was missing through r4)."""
+        from consensus_overlord_tpu.crypto.tpu_provider import _pad_to
+        assert _pad_to(2049) == 4096
+        assert _pad_to(4096) == 4096
+        assert _pad_to(4097) == 8192
+
+    def test_pk_capacity_floor(self, monkeypatch):
+        """CONSENSUS_PK_CAP_MIN pins the pubkey-cache capacity ladder —
+        the cache's row count is part of every kernel's shape, so a
+        capacity crossing is a full kernel-set recompile."""
+        from consensus_overlord_tpu.crypto.tpu_provider import _pk_capacity
+        monkeypatch.delenv("CONSENSUS_PK_CAP_MIN", raising=False)
+        assert _pk_capacity(10) == 256
+        assert _pk_capacity(257) == 1024
+        monkeypatch.setenv("CONSENSUS_PK_CAP_MIN", "16384")
+        assert _pk_capacity(10) == 16384
+        assert _pk_capacity(16384) == 16384
+        monkeypatch.setenv("CONSENSUS_PK_CAP_MIN", "20000")
+        assert _pk_capacity(10) == 32768  # above the ladder: multiple of top
+
     def test_qc_threshold_splits_paths(self, cpus):
         """qc_device_threshold routes the QC paths (aggregate / verify
         aggregated / pubkey validation) independently of the verify
