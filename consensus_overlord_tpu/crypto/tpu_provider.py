@@ -78,9 +78,9 @@ _PK_CAPS = (256, 1024, 4096, 16384)
 # larger hash counts split into pipelined single-hash sub-batches.
 # k=3 has its own rung (r4): the common vote+proposal+choke mix was
 # padding to 4 and paying a full G2 MSM for an always-empty group.
-# Expected ~+25% for 3-hash batches from the MSM count (1 G1 + 3 G2 vs
-# 1 + 4; the measured 3-vs-4-group delta at N=8192 is still pending —
-# the k=3 kernel's first tunnel compile outlived round 4's clock).
+# Measured r5 (scripts/bench_k3_ab.py, interleaved A/B at N=8192,
+# depth-8 pipeline): 11,501 vs 9,314 verifies/s median = 1.235x for
+# 3-hash batches — the rung stays (BASELINE.md r5 ledger).
 _GROUP_SIZES = (2, 3, 4)
 
 
