@@ -110,7 +110,8 @@ def mul_T(x, y):
 
 def main():
     print(f"backend={jax.default_backend()} B={B} K={K} n={n}", flush=True)
-    yl = rng.integers(0, FQ.loose_max + 1, (B, n), dtype=np.int32)
+    # loose_max − 8: headroom for the raw +salt seeds (salt ≤ ITERS).
+    yl = rng.integers(0, FQ.loose_max - 8, (B, n), dtype=np.int32)
     fmac = B * n * n
 
     # Bit-identical check first (CPU-cheap shapes).
@@ -135,7 +136,11 @@ def main():
             yT = y.T  # boundary transpose, amortized over the chain
             def step(c, _):
                 return mul_T(c, yT), None
-            c, _ = lax.scan(step, yT + salt % 3, None, length=length)
+            # salt UNREDUCED into the seed (y is drawn loose_max-8 so
+            # bounds hold): every call must be a distinct computation or
+            # the PJRT relay dedups it to the link floor — the first run
+            # of this script used salt%3 and "measured" 0 us/step.
+            c, _ = lax.scan(step, yT + salt, None, length=length)
             return c.sum()
         return fn
 
@@ -153,8 +158,13 @@ def main():
                 out = terms[0]
                 for t in terms[1:]:
                     out = out + t
-                return out[..., :n] & mask, None
-            c, _ = lax.scan(step, y + salt % 3, None, length=length)
+                # Fold the high half back cheaply so no partial product
+                # is dead code (a plain [:n] truncation lets XLA DCE
+                # every MAC landing at positions >= n).
+                hi = jnp.pad(out[..., n:],
+                             [(0, 0)] * (y.ndim - 1) + [(0, 1)])
+                return (out[..., :n] + hi) & mask, None
+            c, _ = lax.scan(step, y + salt, None, length=length)
             return c.sum()
         return fn
 
@@ -163,7 +173,7 @@ def main():
             def step(c, _):
                 wide = jnp.concatenate([c, c[..., :n - 1]], axis=-1)
                 return FQ._reduce(wide, FQ._conv_bounds()), None
-            c, _ = lax.scan(step, (y + salt % 3) & mask, None, length=length)
+            c, _ = lax.scan(step, (y + salt) & mask, None, length=length)
             return c.sum()
         return fn
 

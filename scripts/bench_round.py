@@ -51,40 +51,46 @@ os.environ.setdefault("CONSENSUS_PAD_MIN", "2048")
 # recompile, ~30-60 min through the remote-compile tunnel).
 os.environ.setdefault("CONSENSUS_PK_CAP_MIN", "16384")
 
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+# Comma-separated scales run in ONE process, largest fixture shared:
+# TPU-tunnel kernels are never persistently cached (executable
+# serialization is unsupported through the relay), so per-scale
+# processes would each re-pay the full kernel-set compile.
+SCALES = ([int(x) for x in sys.argv[1].split(",")] if len(sys.argv) > 1
+          else [1000])
 ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                       f".round_fixture{N}.npz")
 CONTENT = b"bench-round-block"
 
 
-def fixture():
-    """N keypairs + N signed PREVOTE votes on one block hash.  Signing is
-    host-side pure Python (~10 ms/vote) — cached to disk because setup
-    cost is not the thing under test."""
+def fixture(n: int):
+    """n keypairs + n signed PREVOTE votes on one block hash (sks are a
+    fixed arithmetic sequence, so a smaller fixture is a prefix of a
+    larger one).  Signing is host-side pure Python (~10 ms/vote) —
+    cached to disk because setup cost is not the thing under test."""
     import numpy as np
 
     from consensus_overlord_tpu.core.sm3 import sm3_hash
     from consensus_overlord_tpu.core.types import Vote, VoteType
     from consensus_overlord_tpu.crypto import bls12381 as oracle
 
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        f".round_fixture{n}.npz")
     block_hash = sm3_hash(CONTENT)
     vote = Vote(1, 0, VoteType.PREVOTE, block_hash)
     vote_hash = sm3_hash(vote.encode())
-    if os.path.exists(FIXTURE):
-        data = np.load(FIXTURE)
+    if os.path.exists(path):
+        data = np.load(path)
         pks = [bytes(r) for r in data["pks"]]
         sigs = [bytes(r) for r in data["sigs"]]
         return pks, sigs, vote, vote_hash
-    sks = [0xF00D + 131 * i for i in range(N)]
+    sks = [0xF00D + 131 * i for i in range(n)]
     t0 = time.time()
     pks = [oracle.sk_to_pk(sk) for sk in sks]
     sigs = [oracle.sign(sk, vote_hash) for sk in sks]
-    print(f"fixture: signed {N} votes in {time.time() - t0:.0f}s",
+    print(f"fixture: signed {n} votes in {time.time() - t0:.0f}s",
           file=sys.stderr, flush=True)
-    np.savez(FIXTURE,
-             pks=np.frombuffer(b"".join(pks), np.uint8).reshape(N, 96),
-             sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N, 48))
+    np.savez(path,
+             pks=np.frombuffer(b"".join(pks), np.uint8).reshape(n, 96),
+             sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 48))
     return pks, sigs, vote, vote_hash
 
 
@@ -137,8 +143,11 @@ async def one_round(provider, pks, sigs, vote, rep):
     frontier = BatchingVerifier(provider, max_batch=2048, linger_s=0.005)
     eng = Engine(pks[0], adapter, provider, MemoryWal(), frontier=frontier)
     eng.leader = lambda h, r: eng.name  # pin the leader schedule (see module doc)
+    # Huge interval: phase timers must sit far beyond any first-touch
+    # kernel compile absorbed by rep 0 (a mid-compile PROPOSE timeout
+    # would move the engine off round 0 and muddy the rep).
     run_task = asyncio.create_task(
-        eng.run(1, 600_000, authorities))
+        eng.run(1, 7_200_000, authorities))
     await asyncio.sleep(0)  # let the engine enter round 0
 
     votes = [SignedVote(pks[i], sigs[i], vote) for i in range(1, len(pks))]
@@ -189,48 +198,59 @@ async def main():
     from consensus_overlord_tpu.core.types import Node
     from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
 
-    pks, sigs, vote, vote_hash = fixture()
+    n_max = max(SCALES)
+    pks, sigs, vote, vote_hash = fixture(n_max)
     provider = TpuBlsCrypto(0xF00D, device_threshold=32)
 
+    # One fill for the whole run (smaller scales use a row prefix),
+    # chunked to the pad floor so pubkey validation compiles ONE kernel
+    # shape instead of one per scale.
+    chunk = int(os.environ["CONSENSUS_PAD_MIN"])
     t0 = time.time()
-    provider.update_pubkeys(pks)  # per-reconfigure cost, reported separately
+    for i in range(0, n_max, chunk):
+        provider.update_pubkeys(pks[i:i + chunk])
     t_pk = time.time() - t0
-    print(f"pubkey validate+cache ({N}): {t_pk:.1f}s", file=sys.stderr,
+    print(f"pubkey validate+cache ({n_max}): {t_pk:.1f}s", file=sys.stderr,
           flush=True)
 
-    lat, fstats = [], []
-    qc_payload = None
-    for rep in range(ROUNDS + 1):  # rep 0 = first-touch (compiles), split out
-        dt, qc_payload, st = await one_round(provider, pks, sigs, vote, rep)
-        if rep == 0:
-            first = dt
-        else:
-            lat.append(dt)
-            fstats.append(st)
-        print(f"  round {rep}: {dt * 1e3:8.1f} ms  "
-              f"(batches {st.batches}, mean {st.mean_batch:.0f}, "
-              f"max {st.max_batch})", file=sys.stderr, flush=True)
+    for n in SCALES:
+        lat, fstats = [], []
+        qc_payload = None
+        # rep 0 absorbs first-touch compiles for this scale's rungs and
+        # is reported separately.
+        for rep in range(ROUNDS + 1):
+            dt, qc_payload, st = await one_round(
+                provider, pks[:n], sigs[:n], vote, rep)
+            if rep == 0:
+                first = dt
+            else:
+                lat.append(dt)
+                fstats.append(st)
+            print(f"  [{n}] round {rep}: {dt * 1e3:8.1f} ms  "
+                  f"(batches {st.batches}, mean {st.mean_batch:.0f}, "
+                  f"max {st.max_batch})", file=sys.stderr, flush=True)
 
-    authorities = [Node(pk) for pk in pks]
-    fv = []
-    for rep in range(ROUNDS + 1):
-        dt, q = await follower_verify(provider, authorities, qc_payload)
-        if rep:
-            fv.append(dt)
-        print(f"  follower verify {rep}: {dt * 1e3:8.1f} ms ({q} voters)",
-              file=sys.stderr, flush=True)
+        authorities = [Node(pk) for pk in pks[:n]]
+        fv = []
+        for rep in range(ROUNDS + 1):
+            dt, q = await follower_verify(provider, authorities, qc_payload)
+            if rep:
+                fv.append(dt)
+            print(f"  [{n}] follower verify {rep}: {dt * 1e3:8.1f} ms "
+                  f"({q} voters)", file=sys.stderr, flush=True)
 
-    batches = [s.batches for s in fstats]
-    print(json.dumps({
-        "metric": "consensus_round_p50_ms", "validators": N,
-        "rounds": ROUNDS,
-        "leader_p50_ms": round(pctl(lat, 0.5) * 1e3, 1),
-        "leader_p95_ms": round(pctl(lat, 0.95) * 1e3, 1),
-        "leader_first_touch_ms": round(first * 1e3, 1),
-        "follower_qc_verify_p50_ms": round(pctl(fv, 0.5) * 1e3, 1),
-        "frontier_batches_per_round": round(sum(batches) / len(batches), 1),
-        "pubkey_cache_fill_s": round(t_pk, 1),
-    }))
+        batches = [s.batches for s in fstats]
+        print(json.dumps({
+            "metric": "consensus_round_p50_ms", "validators": n,
+            "rounds": ROUNDS,
+            "leader_p50_ms": round(pctl(lat, 0.5) * 1e3, 1),
+            "leader_p95_ms": round(pctl(lat, 0.95) * 1e3, 1),
+            "leader_first_touch_ms": round(first * 1e3, 1),
+            "follower_qc_verify_p50_ms": round(pctl(fv, 0.5) * 1e3, 1),
+            "frontier_batches_per_round":
+                round(sum(batches) / len(batches), 1),
+            "pubkey_cache_fill_s": round(t_pk, 1),
+        }), flush=True)
 
 
 if __name__ == "__main__":
