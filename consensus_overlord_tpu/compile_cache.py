@@ -38,27 +38,44 @@ _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _MAX_BYTES = 4 << 30
 
 
-def _host_fingerprint() -> str:
-    """Digest of the CPU feature set that XLA's AOT loader validates.
-    Two hosts with identical flags can share entries; any difference
-    (the mismatch case) lands in a different namespace."""
+def _host_fingerprint(cpuinfo_path: str = "/proc/cpuinfo") -> str:
+    """Digest of the CPU identity XLA's AOT loader validates.  Keyed on
+    BOTH the feature flags and the `model name` line: XLA's
+    machine-feature set includes model-derived LLVM tuning attributes
+    (e.g. +prefer-no-gather, chosen per CPU model), so two hosts with
+    identical flags but different models can still cross-reject each
+    other's executables.  Over-segregation costs one extra warm compile;
+    under-segregation costs a load-and-reject on every compile."""
     feats = platform.machine()
+    model = ""
+    flags = ""
     try:
-        with open("/proc/cpuinfo") as f:
+        with open(cpuinfo_path) as f:
             for line in f:
-                if line.startswith(("flags", "Features")):
-                    feats += " " + " ".join(sorted(line.split(":", 1)[1]
-                                                   .split()))
+                if not model and line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                elif not flags and line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                if model and flags:
                     break
     except OSError:
+        pass
+    if not model and not flags:
         feats += " " + platform.processor()
+    feats += " " + model + " " + flags
     return hashlib.sha256(feats.encode()).hexdigest()[:12]
 
 
 def _prune_legacy(path: str) -> None:
-    """Delete flat pre-r5 entries at the top level of the cache dir —
-    they are unreadable by any host whose features drifted and invisible
-    to the fingerprinted namespaces, i.e. pure disk cost."""
+    """Delete flat pre-r5 entries at the top level of the repo-default
+    cache dir — they are unreadable by any host whose features drifted
+    and invisible to the fingerprinted namespaces, i.e. pure disk cost.
+    Only ever runs against _DEFAULT_DIR: a user-supplied root
+    (CONSENSUS_JAX_CACHE) may be a flat cache shared with another
+    project or an older build of this repo, whose live entries a prune
+    here would silently delete on every process start."""
+    if os.path.abspath(path) != os.path.abspath(_DEFAULT_DIR):
+        return
     try:
         for name in os.listdir(path):
             if name.endswith("-cache"):
@@ -69,6 +86,48 @@ def _prune_legacy(path: str) -> None:
         pass
 
 
+# -- hit/miss stats -----------------------------------------------------------
+
+#: Process-wide persistent-cache event counts, filled by a jax.monitoring
+#: listener registered on first enable().  Read by obs.Metrics gauges at
+#: scrape time (observability pulls from here; this module stays free of
+#: any obs dependency).  Miss semantics (jax 0.4.x): the cache_misses
+#: event fires when a miss's executable is WRITTEN to the cache, so
+#: compiles below jax_persistent_cache_min_compile_time_secs don't
+#: count — the gauge tracks the expensive misses, which is the signal
+#: that matters.
+_STATS = {"hits": 0, "misses": 0}
+_LISTENER_REGISTERED = False
+
+_EVENT_KEYS = {
+    "/jax/compilation_cache/cache_hits": "hits",
+    "/jax/compilation_cache/cache_misses": "misses",
+}
+
+
+def stats() -> dict:
+    """Snapshot of the compile-cache hit/miss counts (process-wide)."""
+    return dict(_STATS)
+
+
+def _on_event(event: str, **kwargs) -> None:
+    key = _EVENT_KEYS.get(event)
+    if key is not None:
+        _STATS[key] += 1
+
+
+def _register_listener() -> None:
+    global _LISTENER_REGISTERED
+    if _LISTENER_REGISTERED:
+        return
+    try:
+        from jax import monitoring
+        monitoring.register_event_listener(_on_event)
+        _LISTENER_REGISTERED = True
+    except Exception:  # noqa: BLE001 — stats are best-effort
+        pass
+
+
 def enable(cache_dir: str | None = None) -> str:
     """Point JAX's persistent compilation cache at a host-fingerprinted
     namespace under `cache_dir` (default: <repo>/.jax_cache, overridable
@@ -76,6 +135,7 @@ def enable(cache_dir: str | None = None) -> str:
     backend init — and idempotent."""
     import jax
 
+    _register_listener()
     root = (cache_dir or os.environ.get("CONSENSUS_JAX_CACHE")
             or _DEFAULT_DIR)
     path = os.path.join(root, f"host-{_host_fingerprint()}")

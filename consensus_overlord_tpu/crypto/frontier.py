@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
@@ -65,14 +66,19 @@ class BatchingVerifier:
     linger_s: how long the first request of a batch waits for company.
     max_batch: flush immediately at this size (matches the provider's
     padded batch ladder so device kernels stay shape-stable).
+    metrics: optional obs.Metrics — every flush observes batch size,
+    per-request queue wait, padded-batch occupancy, and dispatch/resolve
+    phase latency; failures count by message type.  None = no overhead.
     """
 
     def __init__(self, provider, max_batch: int = 1024,
-                 linger_s: float = 0.002):
+                 linger_s: float = 0.002, metrics=None):
         self._provider = provider
         self._max_batch = max_batch
         self._linger = linger_s
-        self._pending: List[Tuple[bytes, bytes, bytes, asyncio.Future]] = []
+        self._metrics = metrics
+        #: (sig, hash32, voter, future, msg_type, enqueue_ts)
+        self._pending: List[Tuple] = []
         self._flush_task: Optional[asyncio.Task] = None
         # asyncio holds only weak refs to tasks; in-flight batch tasks must
         # be pinned or GC can collect one mid-verify, hanging every waiter.
@@ -87,10 +93,10 @@ class BatchingVerifier:
         self.stats = FrontierStats()
 
     async def verify(self, signature: bytes, hash32: bytes,
-                     voter: bytes) -> bool:
+                     voter: bytes, msg_type: str = "raw") -> bool:
         fut = asyncio.get_running_loop().create_future()
         self._pending.append((bytes(signature), bytes(hash32), bytes(voter),
-                              fut))
+                              fut, msg_type, time.perf_counter()))
         self.stats.requests += 1
         if len(self._pending) >= self._max_batch:
             self._flush_now()
@@ -105,7 +111,7 @@ class BatchingVerifier:
         claims = signature_claims(msg)
         if claims is None:
             return True
-        return await self.verify(*claims)
+        return await self.verify(*claims, msg_type=type(msg).__name__)
 
     async def verify_aggregated(self, agg_sig: bytes, hash32: bytes,
                                 voters) -> bool:
@@ -168,6 +174,13 @@ class BatchingVerifier:
         sigs = [b[0] for b in batch]
         hashes = [b[1] for b in batch]
         voters = [b[2] for b in batch]
+        m = self._metrics
+        if m is not None:
+            # Batch size only; padded-rung occupancy is observed by the
+            # provider at host-prep time (crypto/tpu_provider.py), where
+            # the pad sizes are actually computed — one source of truth
+            # across the fused/split dispatch plans.
+            m.frontier_batch_size.observe(len(batch))
         try:
             verify_async = getattr(self._provider, "verify_batch_async",
                                    None)
@@ -179,20 +192,52 @@ class BatchingVerifier:
                 # dispatch→readback round-trip of a remote PJRT link
                 # with device compute.
                 loop = asyncio.get_running_loop()
+                t0 = time.perf_counter()
                 resolver = await loop.run_in_executor(
                     self._dispatcher, verify_async, sigs, hashes, voters)
+                t1 = time.perf_counter()
                 results = await asyncio.to_thread(resolver)
+                if m is not None:
+                    # frontier_* phases are wrappers AROUND the provider's
+                    # prep/dispatch/readback/pairing phases (they include
+                    # executor queueing), distinct labels so the series
+                    # compose instead of double-counting.
+                    t2 = time.perf_counter()
+                    m.crypto_dispatch_ms.labels(
+                        phase="frontier_dispatch").observe(
+                        (t1 - t0) * 1000.0)
+                    m.crypto_dispatch_ms.labels(
+                        phase="frontier_resolve").observe(
+                        (t2 - t1) * 1000.0)
             else:
                 # Device dispatch blocks; keep the event loop live.
+                t0 = time.perf_counter()
                 results = await asyncio.to_thread(
                     self._provider.verify_batch, sigs, hashes, voters)
+                if m is not None:
+                    m.crypto_dispatch_ms.labels(
+                        phase="frontier_resolve").observe(
+                        (time.perf_counter() - t0) * 1000.0)
+            errored = False
         except Exception:  # noqa: BLE001 — malformed input is never fatal
             logger.exception("frontier batch verification errored")
             results = [False] * len(batch)
+            errored = True
+            if m is not None:
+                # One event under its own label: an infra error must not
+                # masquerade as a per-message-type signature attack.
+                m.frontier_verify_failures.labels(
+                    msg_type="batch_error").inc()
         self.stats.batches += 1
         self.stats.max_batch = max(self.stats.max_batch, len(batch))
-        for (_, _, _, fut), ok in zip(batch, results):
+        now = time.perf_counter()
+        for (_, _, _, fut, msg_type, t_enq), ok in zip(batch, results):
             if not ok:
                 self.stats.failures += 1
+                if m is not None and not errored:
+                    m.frontier_verify_failures.labels(
+                        msg_type=msg_type).inc()
+            if m is not None:
+                m.frontier_queue_wait_ms.observe((now - t_enq) * 1000.0)
             if not fut.done():
                 fut.set_result(bool(ok))

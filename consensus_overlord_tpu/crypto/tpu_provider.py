@@ -42,6 +42,7 @@ from __future__ import annotations
 import os
 import secrets
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -306,6 +307,26 @@ class TpuBlsCrypto:
         # (stable kernel shapes).  Uploaded once per reconfigure — per
         # batch only the (B,) row indices travel over the link.
         self._pk_dev: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+        #: Optional obs.Metrics: host-side phase timings for the device
+        #: path (prep / readback / pairing) land in crypto_dispatch_ms.
+        #: None (the default) keeps the measured bench path untouched.
+        self.metrics = None
+
+    def bind_metrics(self, metrics) -> None:
+        """Attach a metric surface (obs.Metrics).  Observations run on
+        the frontier's dispatch/resolver threads — prometheus_client is
+        thread-safe, and every site is guarded so an unbound provider
+        pays one attribute check."""
+        self.metrics = metrics
+
+    def _observe_phase(self, phase: str, t0: float) -> float:
+        """Observe one host-side device-path phase; returns a fresh
+        timestamp so call sites can chain phases."""
+        now = time.perf_counter()
+        if self.metrics is not None:
+            self.metrics.crypto_dispatch_ms.labels(phase=phase).observe(
+                (now - t0) * 1000.0)
+        return now
 
     def _pad_to(self, n: int) -> int:
         """Pad ladder size, kept a multiple of the mesh lane count so
@@ -459,7 +480,9 @@ class TpuBlsCrypto:
             groups.setdefault(bytes(h), []).append(i)
 
         if len(groups) == 1:
+            t0 = time.perf_counter()
             prep = self._host_prep(signatures, voters, n)
+            self._observe_phase("prep", t0)
             return self._dispatch_single_hash(
                 signatures, bytes(hashes[0]), voters, n, *prep)
         if len(groups) <= _GROUP_SIZES[-1]:
@@ -494,6 +517,15 @@ class TpuBlsCrypto:
         pk_idx = self._pk_rows_of(voters)
         pk_ok = pk_idx >= 0
         size = self._pad_to(n)
+        if self.metrics is not None:
+            # Padded-rung occupancy, observed where the pad is computed:
+            # every device batch — fused single/multi-hash AND each
+            # sub-batch of a >ladder split (which recurses through
+            # verify_batch_async back into here) — reports exactly the
+            # lanes it ships; host-path batches never reach this.
+            self.metrics.frontier_occupancy.observe(n / size)
+            if size > n:
+                self.metrics.frontier_padded_lanes.inc(size - n)
         parsed = dev.parse_g1_compressed(list(signatures))
         sx = np.zeros((size, dev.FQ.n), np.int32)
         sx[:n] = parsed.x
@@ -520,17 +552,21 @@ class TpuBlsCrypto:
                               sx, ssign, sinf, sok, wpacked, rows,
                               pk_idx, pk_ok):
         """Dispatch the fused kernel; return resolve() → List[bool]."""
+        t0 = time.perf_counter()
         pkx, pky, pkz = self._pk_device()
         out = self._kernels.verify_round(
             jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
             jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
             pkx, pky, pkz)
+        self._observe_phase("dispatch", t0)
 
         def resolve() -> List[bool]:
             # ONE device_get: separate per-output reads would each pay a
             # blocking D2H round-trip (~150 ms over a remote PJRT link) —
             # measured at 840 ms of the 1.1 s batch before this was fused.
+            t0 = time.perf_counter()
             ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
+            t0 = self._observe_phase("readback", t0)
             v = valid[:n] & pk_ok
             if not v.any():
                 return [False] * n
@@ -539,8 +575,10 @@ class TpuBlsCrypto:
             h_pt = oracle.hash_to_g1(h, self._common_ref)
             neg_g2 = (oracle.G2_GEN[0],
                       oracle.fq2_neg(oracle.G2_GEN[1]))
-            if oracle.multi_pairing_is_one([(agg_sig, neg_g2),
-                                            (h_pt, agg_pk)]):
+            paired = oracle.multi_pairing_is_one([(agg_sig, neg_g2),
+                                                  (h_pt, agg_pk)])
+            self._observe_phase("pairing", t0)
+            if paired:
                 return list(v)
             # Batch relation failed: exact per-lane localization.
             return [bool(v[i]) and self._verify_one_cached(
@@ -553,6 +591,7 @@ class TpuBlsCrypto:
                              groups: Dict[bytes, List[int]]):
         """Dispatch the k-group fused kernel (k padded up the group-count
         ladder with empty masks); return resolve() → List[bool]."""
+        t0 = time.perf_counter()
         (size, sx, ssign, sinf, sok, wpacked, rows,
          pk_idx, pk_ok) = self._host_prep(signatures, voters, n)
         k = next(s for s in _GROUP_SIZES if len(groups) <= s)
@@ -560,15 +599,19 @@ class TpuBlsCrypto:
         ghashes = list(groups)
         for g, h in enumerate(ghashes):
             gmask[g, groups[h]] = True
+        t0 = self._observe_phase("prep", t0)
         pkx, pky, pkz = self._pk_device()
         out = self._kernels.verify_round_multi(
             jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
             jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
             jnp.asarray(gmask), pkx, pky, pkz)
+        self._observe_phase("dispatch", t0)
         lane_hashes = self._lane_hashes(groups, n)
 
         def resolve() -> List[bool]:
+            t0 = time.perf_counter()
             flat = jax.device_get(out)
+            t0 = self._observe_phase("readback", t0)
             ax, ay, ainf, valid = flat[:4]
             v = valid[:n] & pk_ok
             if not v.any():
@@ -584,7 +627,9 @@ class TpuBlsCrypto:
                     continue
                 pairs.append((oracle.hash_to_g1(h, self._common_ref),
                               agg_pk))
-            if oracle.multi_pairing_is_one(pairs):
+            paired = oracle.multi_pairing_is_one(pairs)
+            self._observe_phase("pairing", t0)
+            if paired:
                 return list(v)
             # Batch relation failed: exact per-lane localization.
             return [bool(v[i]) and self._verify_one_cached(

@@ -203,11 +203,18 @@ class Engine:
 
     def __init__(self, name: Address, adapter: ConsensusAdapter,
                  crypto: CryptoProvider, wal: Wal,
-                 frontier=None, tracer=None):
+                 frontier=None, tracer=None, metrics=None, recorder=None):
         self.name = bytes(name)
         self.adapter = adapter
         self.crypto = crypto
         self.wal = wal
+        #: Optional obs.Metrics: round durations, view-change/choke
+        #: counters, committed heights.  None = zero hot-path overhead.
+        self.metrics = metrics
+        #: Optional obs.FlightRecorder: structured event ring (state
+        #: transitions, QC formation, frontier drops) dumped by the sim
+        #: harness / Byzantine tests on failure and served by /statusz.
+        self.recorder = recorder
         #: Optional batching frontier (crypto/frontier.py).  When present,
         #: inbound messages entering through inject_inbound() have their
         #: signatures verified there in device-sized batches, and the
@@ -273,6 +280,9 @@ class Engine:
         self._running = False
         #: wall-clock of the last commit, for block-interval pacing
         self._last_commit_ts: float = 0.0
+        #: perf_counter at the current round's entry (0 = no round yet);
+        #: the next round/height transition observes the duration.
+        self._round_t0: float = 0.0
 
     # -- public API --------------------------------------------------------
 
@@ -321,6 +331,9 @@ class Engine:
                     self.lock_proposal.content
             logger.info("%s: WAL recovery to height=%d round=%d",
                         self._tag(), start_height, start_round)
+            if self.recorder is not None:
+                self.recorder.record("wal_recovery", height=start_height,
+                                     round=start_round)
         self._trace_begin_height()
         await self._enter_round(start_round)
         try:
@@ -355,9 +368,19 @@ class Engine:
         signatures are dropped here; without one, the engine's per-message
         verifies in the handlers apply.  Returns False iff dropped."""
         if self.frontier is not None:
-            if not await self.frontier.verify_msg(msg):
+            span_id, parent, start_us = self._child_span_begin()
+            ok = await self.frontier.verify_msg(msg)
+            self._emit_span("consensus.frontier_verify", span_id, parent,
+                            start_us, {"msg_type": type(msg).__name__,
+                                       "ok": str(ok).lower()})
+            if not ok:
                 logger.warning("%s: frontier dropped %s (bad signature)",
                                self._tag(), type(msg).__name__)
+                if self.recorder is not None:
+                    self.recorder.record("frontier_drop",
+                                         msg_type=type(msg).__name__,
+                                         height=self.height,
+                                         round=self.round)
                 return False
         self.handler.send_msg(msg)
         return True
@@ -466,6 +489,9 @@ class Engine:
         logger.info("%s: commit/status -> height %d", self._tag(), status.height)
         self._trace_end_round()
         self._trace_end_height(committed=committed)
+        if self.recorder is not None:
+            self.recorder.record("enter_height", height=status.height,
+                                 committed=committed)
         self._last_commit_ts = asyncio.get_running_loop().time()
         self.height = status.height
         self._trace_begin_height()
@@ -484,17 +510,27 @@ class Engine:
         self._drain_pending()
 
     async def _enter_round(self, round_: int) -> None:
+        now = time.perf_counter()
+        if self.metrics is not None and self._round_t0 > 0:
+            self.metrics.round_duration_ms.observe(
+                (now - self._round_t0) * 1000.0)
+        self._round_t0 = now
         self._trace_end_round()
         self.round = round_
         self.step = Step.PROPOSE
         self._trace_begin_round()
         self._cancel_timers()
+        if self.recorder is not None:
+            self.recorder.record("enter_round", height=self.height,
+                                 round=round_)
         # Drop per-round state that fell out of the live-round window
         # (memory stays O(ROUND_WINDOW) regardless of round spray).
+        # _choke_round_hist is included: its per-validator decrement in
+        # _on_signed_choke tolerates pruned buckets via .get().
         floor = round_ - self.ROUND_WINDOW
         for rounds_map in (self._prevotes, self._precommits, self._chokes,
                            self._choke_weight, self._prevote_qcs,
-                           self._proposals):
+                           self._proposals, self._choke_round_hist):
             for r in [r for r in rounds_map if r < floor]:
                 del rounds_map[r]
         await self._save_wal()
@@ -576,6 +612,49 @@ class Engine:
                         {"height": str(self.height), "round": str(self.round),
                          "step": Step(self.step).name.lower()})
         self._round_start_us = 0
+
+    def _child_span_begin(self, parent: Optional[int] = None):
+        """(span_id, parent_span_id, start_us) for a new child of the
+        current round span (or an explicit parent); zeros — which make
+        _emit_span a no-op — when untraced."""
+        if self.tracer is None:
+            return 0, 0, 0
+        from ..obs.tracing import new_span_id
+        return (new_span_id(),
+                self._round_span_id if parent is None else parent,
+                int(time.time() * 1e6))
+
+    def _bind_span_ctx(self, span_id: int) -> None:
+        """Make `span_id` the calling task's outbound trace context:
+        Brain gRPC calls stamp it into `traceparent` (service/rpc.py
+        RetryClient.call), so the controller's server span nests under
+        this engine child span.  Call only from _spawn'd sub-tasks —
+        each task owns a contextvar copy, so no reset is needed."""
+        if self.tracer is None or span_id == 0:
+            return
+        from ..obs.logctx import span_context, trace_context
+        trace_context.set(f"{self._trace_id:032x}")
+        span_context.set(f"{span_id:016x}")
+
+    # -- statusz -----------------------------------------------------------
+
+    def status(self) -> dict:
+        """Live engine state for /statusz (read from the exporter's HTTP
+        thread: plain attribute reads, no locking needed beyond the GIL)."""
+        try:
+            leader = self.leader(self.height, self.round).hex()
+        except Exception:  # noqa: BLE001 — pre-run: no authorities yet
+            leader = ""
+        return {
+            "name": self.name.hex(),
+            "height": self.height,
+            "round": self.round,
+            "step": Step(self.step).name,
+            "leader": leader,
+            "validators": len(self.authorities),
+            "lock_round": self.lock_round,
+            "committing": self._committing,
+        }
 
     # -- proposing ---------------------------------------------------------
 
@@ -752,6 +831,8 @@ class Engine:
 
     async def _check_block(self, height: int, round_: int, block_hash: Hash,
                            content: bytes) -> None:
+        span_id, parent, start_us = self._child_span_begin()
+        self._bind_span_ctx(span_id)  # runs as its own _spawn'd task
         if block_hash == NIL_HASH:
             ok = False
         else:
@@ -760,6 +841,9 @@ class Engine:
             except Exception:  # noqa: BLE001
                 logger.exception("%s: check_block failed", self._tag())
                 ok = False
+        self._emit_span("consensus.check_block", span_id, parent, start_us,
+                        {"height": str(height), "round": str(round_),
+                         "ok": str(ok).lower()})
         self._mailbox.put_nowait(_BlockChecked(height, round_, block_hash, ok))
 
     async def _on_block_checked(self, msg: _BlockChecked) -> None:
@@ -856,6 +940,10 @@ class Engine:
             vote_type=vote_type, height=self.height, round=round_,
             block_hash=block_hash, leader=self.name)
         vote_set.qc_sent = True
+        if self.recorder is not None:
+            self.recorder.record(
+                "qc_formed", height=self.height, round=round_,
+                vote_type=VoteType(vote_type).name, voters=len(pairs))
         await self.adapter.broadcast_to_other(
             MSG_TYPE_AGGREGATED_VOTE, qc.encode())
         await self._on_aggregated_vote(qc)  # self-delivery
@@ -874,6 +962,10 @@ class Engine:
                 return
         if not await self._verify_qc(qc):
             logger.warning("%s: bad QC", self._tag())
+            if self.recorder is not None:
+                self.recorder.record(
+                    "qc_rejected", height=qc.height, round=qc.round,
+                    vote_type=VoteType(qc.vote_type).name)
             return
         if qc.vote_type == VoteType.PREVOTE:
             await self._on_prevote_qc(qc)
@@ -925,12 +1017,26 @@ class Engine:
         self._spawn(self._commit(qc.height, self._pending_commit))
 
     async def _commit(self, height: int, commit: Commit) -> None:
+        # Parent the commit span on the HEIGHT span: the commit ends the
+        # height, and a round transition mid-commit must not reparent it.
+        span_id, parent, start_us = self._child_span_begin(
+            parent=self._height_span_id)
+        self._bind_span_ctx(span_id)  # runs as its own _spawn'd task
+        ok = True
         try:
             status = await self.adapter.commit(height, commit)
         except Exception:  # noqa: BLE001
             logger.exception("%s: commit failed", self._tag())
-            self._mailbox.put_nowait(_Committed(height, None))
-            return
+            ok = False
+            status = None
+        if ok and status is not None and self.metrics is not None:
+            # Counted where the adapter accepted the commit, not at the
+            # height transition: a RichStatus resync can pull the node
+            # forward before its own _Committed message is processed,
+            # and the commit this node drove must still count.
+            self.metrics.committed_heights.inc()
+        self._emit_span("consensus.commit", span_id, parent, start_us,
+                        {"height": str(height), "ok": str(ok).lower()})
         self._mailbox.put_nowait(_Committed(height, status))
 
     async def _on_committed(self, msg: _Committed) -> None:
@@ -956,6 +1062,8 @@ class Engine:
             return
         logger.info("%s: retrying commit at height %d", self._tag(),
                     msg.height)
+        if self.recorder is not None:
+            self.recorder.record("commit_retry", height=msg.height)
         self._spawn(self._commit(msg.height, self._pending_commit))
 
     # -- choke / view change ----------------------------------------------
@@ -985,9 +1093,13 @@ class Engine:
         prev = self._choke_rounds.get(sc.address)
         if prev is None or c.round > prev:
             if prev is not None:
-                self._choke_round_hist[prev] -= w
-                if self._choke_round_hist[prev] <= 0:
-                    del self._choke_round_hist[prev]
+                # .get: the prev bucket may have been GC'd by
+                # _enter_round's live-window pruning.
+                remaining = self._choke_round_hist.get(prev, 0) - w
+                if remaining <= 0:
+                    self._choke_round_hist.pop(prev, None)
+                else:
+                    self._choke_round_hist[prev] = remaining
             self._choke_round_hist[c.round] = (
                 self._choke_round_hist.get(c.round, 0) + w)
             self._choke_rounds[sc.address] = c.round
@@ -995,6 +1107,7 @@ class Engine:
                 and c.round >= self.round:
             self.adapter.report_view_change(
                 self.height, self.round, "TIMEOUT_BRAKE quorum")
+            self._note_view_change("choke_quorum", c.round + 1)
             await self._enter_round(c.round + 1)
             return
         # Round skip (liveness after partition heal): if f+1 weight is choking
@@ -1015,9 +1128,23 @@ class Engine:
         if skip_to is not None:
             self.adapter.report_view_change(
                 self.height, self.round, f"round skip to {skip_to}")
+            self._note_view_change("round_skip", skip_to)
             await self._enter_round(skip_to)
 
+    def _note_view_change(self, reason: str, to_round: int) -> None:
+        if self.metrics is not None:
+            self.metrics.view_changes.labels(reason=reason).inc()
+        if self.recorder is not None:
+            self.recorder.record("view_change", reason=reason,
+                                 height=self.height, round=self.round,
+                                 to_round=to_round)
+
     async def _broadcast_choke(self) -> None:
+        if self.metrics is not None:
+            self.metrics.chokes_sent.inc()
+        if self.recorder is not None:
+            self.recorder.record("choke_sent", height=self.height,
+                                 round=self.round)
         choke = Choke(self.height, self.round)
         sig = self.crypto.sign(sm3_hash(choke.encode()))
         sc = SignedChoke(sig, self.name, choke)

@@ -7,34 +7,48 @@ guarding concurrent save/load (src/consensus.rs:299), and load returning None
 when nothing was ever saved (src/consensus.rs:324-331).
 
 The overwrite is made atomic via write-to-temp + rename (an improvement over
-the reference's bare fs::write, which can tear on crash mid-write)."""
+the reference's bare fs::write, which can tear on crash mid-write).
+
+Every save happens on the consensus critical path (write-ahead of each
+vote cast), so both WALs accept an optional obs.Metrics and observe
+append latency — the file WAL additionally isolates the fsync portion,
+the usual stall source on loaded disks."""
 
 from __future__ import annotations
 
 import asyncio
 import os
+import time
 from typing import Optional
 
 OVERLORD_WAL_NAME = "overlord.wal"  # reference src/consensus.rs:301
 
 
 class FileWal:
-    def __init__(self, wal_path: str):
+    def __init__(self, wal_path: str, metrics=None):
         os.makedirs(wal_path, exist_ok=True)
         self._path = os.path.join(wal_path, OVERLORD_WAL_NAME)
         self._tmp_path = self._path + ".tmp"
         self._lock = asyncio.Lock()
+        self._metrics = metrics
 
     async def save(self, data: bytes) -> None:
         async with self._lock:
             await asyncio.to_thread(self._write_atomic, bytes(data))
 
     def _write_atomic(self, data: bytes) -> None:
+        t0 = time.perf_counter()
         with open(self._tmp_path, "wb") as f:
             f.write(data)
             f.flush()
+            t_sync = time.perf_counter()
             os.fsync(f.fileno())
+            fsync_s = time.perf_counter() - t_sync
         os.replace(self._tmp_path, self._path)
+        if self._metrics is not None:
+            self._metrics.wal_fsync_ms.observe(fsync_s * 1000.0)
+            self._metrics.wal_append_ms.observe(
+                (time.perf_counter() - t0) * 1000.0)
 
     async def load(self) -> Optional[bytes]:
         async with self._lock:
@@ -49,13 +63,20 @@ class FileWal:
 
 
 class MemoryWal:
-    """In-process WAL for simulations and tests."""
+    """In-process WAL for simulations and tests.  Observes append latency
+    (if given metrics) so sim runs exercise the same metric surface as a
+    production FileWal — minus the fsync, which has no analog here."""
 
-    def __init__(self):
+    def __init__(self, metrics=None):
         self._data: Optional[bytes] = None
+        self._metrics = metrics
 
     async def save(self, data: bytes) -> None:
+        t0 = time.perf_counter()
         self._data = bytes(data)
+        if self._metrics is not None:
+            self._metrics.wal_append_ms.observe(
+                (time.perf_counter() - t0) * 1000.0)
 
     async def load(self) -> Optional[bytes]:
         return self._data

@@ -5,28 +5,36 @@ tracer init; src/main.rs:248-260 metrics middleware + exporter; tracing
 `#[instrument]` spans with cross-service parent propagation at
 src/main.rs:96, 111, 137).  Here:
 
-  metrics.py — per-RPC latency histograms (the MiddlewareLayer analog) +
-               a Prometheus exporter on `metrics_port`
-  logctx.py  — logging init from LogConfig + W3C traceparent extraction
-               from gRPC metadata into contextvars, stamped onto every
-               log record (the `set_parent` analog); per-request server
-               spans when an exporter is attached
-  tracing.py — Jaeger-agent span export (thrift compact over UDP,
-               dependency-free), honoring log_config.agent_endpoint
+  metrics.py   — hot-path metric families (RPC latency, frontier batch
+                 shape, device dispatch phases, engine round cadence,
+                 WAL latency, compile-cache hit rate) + one HTTP server
+                 on `metrics_port` serving /metrics and /statusz
+  flightrec.py — bounded ring buffer of structured engine events (state
+                 transitions, QC formation, frontier drops) for test
+                 failure dumps and the /statusz tail
+  logctx.py    — logging init from LogConfig + W3C traceparent extraction
+                 from gRPC metadata into contextvars, stamped onto every
+                 log record (the `set_parent` analog); per-request server
+                 spans when an exporter is attached
+  tracing.py   — Jaeger-agent span export (thrift compact over UDP,
+                 dependency-free), honoring log_config.agent_endpoint
 """
 
+from .flightrec import FlightRecorder
 from .logctx import (init_logging, span_context, trace_context,
                      TraceContextInterceptor)
-from .metrics import Metrics, MetricsInterceptor
+from .metrics import Metrics, MetricsInterceptor, snapshot
 from .tracing import JaegerExporter, Span
 
 __all__ = [
+    "FlightRecorder",
     "JaegerExporter",
     "Metrics",
     "MetricsInterceptor",
     "Span",
     "TraceContextInterceptor",
     "init_logging",
+    "snapshot",
     "span_context",
     "trace_context",
 ]

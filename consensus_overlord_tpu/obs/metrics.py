@@ -1,36 +1,79 @@
-"""Per-RPC latency histograms + Prometheus exporter.
+"""Hot-path metric surface + combined /metrics + /statusz HTTP exporter.
 
-The reference wraps its tonic server in a `MiddlewareLayer` that measures
-every gRPC request into configurable histogram buckets and serves them from
-a separate exporter task on `metrics_port` (reference src/main.rs:248-260;
-bucket defaults src/config.rs:43-45 — values are milliseconds, 0.25..500).
+The reference's only metrics are a per-RPC latency middleware and an
+exporter task (reference src/main.rs:248-260; bucket defaults
+src/config.rs:43-45 — values are milliseconds, 0.25..500).  That leaves
+the TPU north-star path dark: the batching frontier's shape (linger
+misconfiguration shows up as small batches), the device dispatch
+pipeline (a remote PJRT link makes every phase latency-critical), and
+the engine's round/WAL cadence.  `Metrics` covers all of them; every
+instrument is optional at each call site (None = zero overhead) so
+bench.py's measured path stays untouched unless a registry is attached.
 
-Here the middleware is a grpc.aio server interceptor and the exporter is
-prometheus_client's threaded HTTP server.  Each `Metrics` owns its own
-registry so multiple nodes can live in one test process.
+Metric families (all per-`Metrics`, each owns its CollectorRegistry so
+multiple nodes can live in one test process):
+
+  RPC        grpc_server_handling_ms{method}, grpc_server_handled_total
+             {method,code} — code is the REAL gRPC status (context.code()
+             after aborts/set_code), not a binary OK/ERROR
+  frontier   frontier_batch_size, frontier_queue_wait_ms,
+             frontier_batch_occupancy (real/padded lanes),
+             frontier_padded_lanes_total,
+             frontier_verify_failures_total{msg_type}
+  device     crypto_dispatch_ms{phase} — host-side phase split:
+             prep (parse/pad/RLC draw), dispatch (kernel enqueue),
+             readback (device round-trip), pairing (host pairing check)
+  engine     consensus_round_duration_ms, consensus_view_changes_total
+             {reason}, consensus_chokes_sent_total,
+             consensus_committed_heights_total
+  wal        wal_append_ms, wal_fsync_ms
+  compile    compile_cache_hits / compile_cache_misses — gauges read from
+             compile_cache.stats() (a jax.monitoring listener) at scrape
+
+The exporter serves `/metrics` (Prometheus text), and `/statusz` +
+`/debug/vars` (JSON assembled from registered status sources: current
+height/round/leader, frontier stats, flight-recorder tail) from one
+HTTP server on `metrics_port`.
 """
 
 from __future__ import annotations
 
-import time
-from typing import Optional, Sequence
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Sequence
 
 import grpc
 from prometheus_client import (
     CollectorRegistry,
     Counter,
+    Gauge,
     Histogram,
-    start_http_server,
 )
+from prometheus_client.exposition import CONTENT_TYPE_LATEST, generate_latest
+
+import time
 
 #: reference src/config.rs:43-45 (milliseconds)
 DEFAULT_BUCKETS = (0.25, 0.5, 0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 25.0, 50.0,
                    75.0, 100.0, 250.0, 500.0)
+#: Device dispatch phases reach seconds on a remote PJRT link and minutes
+#: on a cold jit compile — the RPC buckets top out far too low.
+DEVICE_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                  500.0, 1000.0, 2500.0, 10000.0, 60000.0, 300000.0)
+#: Round durations span sub-ms (sim fleets) to tens of seconds (view
+#: changes backing off under partition).
+ROUND_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+#: Real-lane fraction of a padded device batch (1.0 = the batch exactly
+#: filled its pad rung).
+OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 
 
 class Metrics:
-    """One node's metric surface: RPC latency histogram, engine counters,
-    frontier batch-size histogram."""
+    """One node's metric surface: RPC latency, frontier/device hot path,
+    engine round cadence, WAL latency, compile-cache hit rate."""
 
     def __init__(self, buckets: Optional[Sequence[float]] = None):
         self.registry = CollectorRegistry()
@@ -43,37 +86,194 @@ class Metrics:
             "grpc_server_handled_total",
             "gRPC requests handled", ["method", "code"],
             registry=self.registry)
+
+        # -- frontier (crypto/frontier.py) --------------------------------
         self.frontier_batch_size = Histogram(
             "frontier_batch_size",
             "Signature-verification batch sizes at the frontier",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048),
             registry=self.registry)
+        self.frontier_queue_wait_ms = Histogram(
+            "frontier_queue_wait_ms",
+            "Time a verify request waits at the frontier before its "
+            "batch result resolves (linger + dispatch + readback)",
+            buckets=DEVICE_BUCKETS, registry=self.registry)
+        self.frontier_occupancy = Histogram(
+            "frontier_batch_occupancy",
+            "Real lanes / padded lanes per flushed device batch",
+            buckets=OCCUPANCY_BUCKETS, registry=self.registry)
+        self.frontier_padded_lanes = Counter(
+            "frontier_padded_lanes_total",
+            "Padding lanes dispatched to the device (wasted MSM work)",
+            registry=self.registry)
+        self.frontier_verify_failures = Counter(
+            "frontier_verify_failures_total",
+            "Signatures rejected at the frontier, by message type",
+            ["msg_type"], registry=self.registry)
+
+        # -- device dispatch (crypto/tpu_provider.py + frontier) ----------
+        self.crypto_dispatch_ms = Histogram(
+            "crypto_dispatch_ms",
+            "Host-side device-path phase latency "
+            "(prep/dispatch/readback/pairing)",
+            ["phase"], buckets=DEVICE_BUCKETS, registry=self.registry)
+
+        # -- engine (engine/smr.py) ---------------------------------------
+        self.round_duration_ms = Histogram(
+            "consensus_round_duration_ms",
+            "Wall-clock per consensus round (entry to exit)",
+            buckets=ROUND_BUCKETS, registry=self.registry)
+        self.view_changes = Counter(
+            "consensus_view_changes_total",
+            "View changes, by trigger", ["reason"], registry=self.registry)
+        self.chokes_sent = Counter(
+            "consensus_chokes_sent_total",
+            "SignedChoke broadcasts by this node", registry=self.registry)
         self.committed_heights = Counter(
             "consensus_committed_heights_total",
             "Heights committed by this node", registry=self.registry)
-        self._exporter = None
+
+        # -- WAL (engine/wal.py) ------------------------------------------
+        self.wal_append_ms = Histogram(
+            "wal_append_ms", "WAL save latency, end to end (ms)",
+            buckets=buckets, registry=self.registry)
+        self.wal_fsync_ms = Histogram(
+            "wal_fsync_ms", "WAL fsync portion of a save (ms)",
+            buckets=buckets, registry=self.registry)
+
+        # -- compile cache (compile_cache.py) -----------------------------
+        # Gauges read the module-level event counts at scrape time (the
+        # jax.monitoring listener fills them process-wide).
+        from .. import compile_cache as _cc
+        hits = Gauge("compile_cache_hits",
+                     "Persistent XLA compile-cache hits (process-wide)",
+                     registry=self.registry)
+        hits.set_function(lambda: _cc.stats()["hits"])
+        misses = Gauge("compile_cache_misses",
+                       "Persistent XLA compile-cache misses (process-wide)",
+                       registry=self.registry)
+        misses.set_function(lambda: _cc.stats()["misses"])
+
+        self._exporter: Optional[ThreadingHTTPServer] = None
+        self._exporter_thread: Optional[threading.Thread] = None
+        #: /statusz sources: name → zero-arg callable returning something
+        #: JSON-encodable.  Registered by service/main.py (engine state,
+        #: frontier stats, flight-recorder tail).
+        self._status_sources: Dict[str, Callable[[], object]] = {}
 
     def interceptor(self) -> "MetricsInterceptor":
         return MetricsInterceptor(self)
 
-    def start_exporter(self, port: int, addr: str = "0.0.0.0") -> int:
-        """Serve /metrics on `port` (0 = OS-assigned); returns the bound
-        port.  The reference's run_metrics_exporter analog
-        (src/main.rs:249-251)."""
-        server, _thread = start_http_server(
-            port, addr=addr, registry=self.registry)
+    # -- statusz -----------------------------------------------------------
+
+    def add_status_source(self, name: str,
+                          fn: Callable[[], object]) -> None:
+        """Register a /statusz section.  `fn` runs on the exporter's HTTP
+        thread at request time — it must be cheap and thread-safe."""
+        self._status_sources[name] = fn
+
+    def statusz(self) -> dict:
+        """Assemble the /statusz document.  A failing source reports its
+        error instead of taking the endpoint down."""
+        doc: dict = {"ts": time.time()}
+        for name, fn in list(self._status_sources.items()):
+            try:
+                doc[name] = fn()
+            except Exception as e:  # noqa: BLE001 — degrade per-section
+                doc[name] = {"error": repr(e)}
+        return doc
+
+    # -- exporter ----------------------------------------------------------
+
+    def start_exporter(self, port: int, addr: str = "0.0.0.0",
+                       statusz_public: bool = False) -> int:
+        """Serve /metrics (Prometheus text) and /statusz + /debug/vars
+        (JSON) on `port` (0 = OS-assigned); returns the bound port.  The
+        reference's run_metrics_exporter analog (src/main.rs:249-251),
+        extended with the status endpoint.
+
+        statusz_public=False (default): /statusz answers loopback
+        clients only — it exposes live consensus position, lock state,
+        and the flight-recorder tail, reconnaissance-grade detail an
+        adversary could time attacks with, while /metrics stays
+        fleet-scrapeable like the reference's exporter."""
+        metrics = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                if path in ("/statusz", "/debug/vars"):
+                    if not statusz_public and not _loopback(
+                            self.client_address[0]):
+                        self.send_error(403, "statusz is loopback-only "
+                                        "(set statusz_public to expose)")
+                        return
+                    body = json.dumps(metrics.statusz(),
+                                      default=repr).encode()
+                    ctype = "application/json"
+                elif path in ("/", "/metrics"):
+                    body = generate_latest(metrics.registry)
+                    ctype = CONTENT_TYPE_LATEST
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log events
+                pass
+
+        server = ThreadingHTTPServer((addr, port), _Handler)
+        server.daemon_threads = True
+        thread = threading.Thread(target=server.serve_forever,
+                                  name="obs-exporter", daemon=True)
+        thread.start()
         self._exporter = server
+        self._exporter_thread = thread
         return server.server_address[1]
 
     def stop_exporter(self) -> None:
         if self._exporter is not None:
             self._exporter.shutdown()
+            self._exporter.server_close()
             self._exporter = None
+            self._exporter_thread = None
+
+
+def _loopback(host: str) -> bool:
+    """Is the peer address a loopback interface?  (IPv4-mapped IPv6
+    included — ThreadingHTTPServer reports it for v6 dual-stack binds.)"""
+    return host in ("127.0.0.1", "::1") or host.startswith("127.") \
+        or host == "::ffff:127.0.0.1"
+
+
+def snapshot(registry: CollectorRegistry, prefix: str = "") -> dict:
+    """Flatten a registry into {sample_name[{labels}]: value} — counters
+    and gauges as floats, histograms as their _bucket/_count/_sum
+    samples.  Used by sim/run.py and scripts/bench_round.py to carry the
+    scraped batch-shape data in their JSON output."""
+    out: dict = {}
+    for family in registry.collect():
+        if prefix and not family.name.startswith(prefix):
+            continue
+        for s in family.samples:
+            if s.name.endswith("_created"):
+                continue  # creation wall-clock: pure diff noise in ledgers
+            labels = ",".join(f"{k}={v}" for k, v in sorted(s.labels.items()))
+            key = f"{s.name}{{{labels}}}" if labels else s.name
+            out[key] = s.value
+    return out
 
 
 class MetricsInterceptor(grpc.aio.ServerInterceptor):
     """Times every unary RPC into the latency histogram — the tower
-    MiddlewareLayer analog (reference src/main.rs:253-256)."""
+    MiddlewareLayer analog (reference src/main.rs:253-256).  The handled
+    counter records the REAL status code: whatever the handler set via
+    set_code()/abort() (read back off the context), OK on a clean
+    return, CANCELLED/UNKNOWN on cancellation or an unexpected raise."""
 
     def __init__(self, metrics: Metrics):
         self._m = metrics
@@ -88,16 +288,30 @@ class MetricsInterceptor(grpc.aio.ServerInterceptor):
 
         async def timed(request, context):
             t0 = time.perf_counter()
-            code = "OK"
+            failure = None
             try:
                 return await inner(request, context)
-            except BaseException:
-                code = "ERROR"
+            except BaseException as e:
+                failure = e
                 raise
             finally:
+                code = None
+                try:
+                    code = context.code()  # set_code()/abort() record here
+                except Exception:  # noqa: BLE001 — introspection only
+                    pass
+                if code is None:
+                    if failure is None:
+                        code = grpc.StatusCode.OK
+                    elif isinstance(failure, asyncio.CancelledError):
+                        code = grpc.StatusCode.CANCELLED
+                    else:
+                        code = grpc.StatusCode.UNKNOWN
+                label = code.name if isinstance(code, grpc.StatusCode) \
+                    else str(code)
                 metrics.rpc_latency_ms.labels(method=method).observe(
                     (time.perf_counter() - t0) * 1000.0)
-                metrics.rpc_total.labels(method=method, code=code).inc()
+                metrics.rpc_total.labels(method=method, code=label).inc()
 
         return grpc.unary_unary_rpc_method_handler(
             timed,

@@ -9,9 +9,13 @@ reference deployment's config file drops in unchanged.  Extra
 from __future__ import annotations
 
 import dataclasses
-import tomllib
 from dataclasses import dataclass, field
 from typing import List, Optional
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: dict construction still works
+    tomllib = None
 
 DEFAULT_METRICS_BUCKETS = [
     0.25, 0.5, 0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 25.0, 50.0, 75.0, 100.0,
@@ -48,6 +52,17 @@ class ConsensusConfig:
     crypto_backend: str = "tpu"          # "tpu" | "cpu"
     frontier_max_batch: int = 1024
     frontier_linger_ms: float = 2.0
+    #: Engine flight recorder (obs/flightrec.py): ring capacity in
+    #: events; 0 disables recording entirely.
+    flight_recorder_capacity: int = 512
+    #: Events served in the /statusz flight-recorder tail (bounded so a
+    #: scrape never ships the whole ring).
+    statusz_tail: int = 64
+    #: /statusz + /debug/vars answer loopback clients only unless this is
+    #: set: they expose live consensus position and the flight-recorder
+    #: tail, which is reconnaissance material on a routable host.
+    #: /metrics stays reachable either way (fleet Prometheus scrapes).
+    statusz_public: bool = False
     #: gRPC method-path namespace: "native" serves/dials
     #: consensus_overlord_tpu.* paths; "cita_cloud" uses the reference
     #: mesh's cita_cloud_proto package names (src/main.rs:64-73) so this
@@ -59,6 +74,10 @@ class ConsensusConfig:
              section: str = "consensus_overlord") -> "ConsensusConfig":
         """Read one named TOML section with per-field defaults (the
         reference's read_toml + serde-default shape, src/config.rs:52-56)."""
+        if tomllib is None:
+            raise RuntimeError(
+                "TOML config loading requires Python >= 3.11 (tomllib); "
+                "construct ConsensusConfig directly or via from_dict()")
         with open(path, "rb") as f:
             doc = tomllib.load(f)
         table = doc.get(section, {})

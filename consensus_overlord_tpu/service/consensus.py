@@ -71,7 +71,7 @@ class Consensus:
     def __init__(self, config: ConsensusConfig, private_key: int,
                  controller: Optional[ControllerClient] = None,
                  network: Optional[NetworkClient] = None,
-                 crypto=None, tracer=None):
+                 crypto=None, tracer=None, metrics=None, recorder=None):
         self.config = config
         # Explicit compat: method paths bake at construction, and the
         # global default is shared process-wide (rpc.full_service_name).
@@ -80,19 +80,29 @@ class Consensus:
         self.network = network or NetworkClient(
             config.network_port, compat=config.proto_compat)
         self.crypto = crypto or _make_crypto(config.crypto_backend, private_key)
-        self.wal = FileWal(config.wal_path)
+        # One metric surface threads through every hot-path layer: the
+        # WAL (append/fsync), the frontier (batch shape + queue wait),
+        # the provider (device dispatch phases), and the engine (rounds,
+        # view changes, commits).  None everywhere = the pre-obs paths.
+        self.metrics = metrics
+        self.recorder = recorder
+        self.wal = FileWal(config.wal_path, metrics=metrics)
         self.brain = GrpcBrain(self.crypto, self.controller, self.network)
         # The frontier is the single inbound verification point; the engine
         # is constructed WITH it, so "inbound_verified" cannot drift from
         # whether a frontier actually guards the injection path.
         self.frontier = BatchingVerifier(
             self.crypto, max_batch=config.frontier_max_batch,
-            linger_s=config.frontier_linger_ms / 1000.0)
+            linger_s=config.frontier_linger_ms / 1000.0, metrics=metrics)
+        bind = getattr(self.crypto, "bind_metrics", None)
+        if bind is not None and metrics is not None:
+            bind(metrics)
         # tracer: the engine emits height/round/QC-verify spans through the
         # same exporter the gRPC layer uses (reference #[instrument]
         # coverage, src/consensus.rs:96,143,209).
         self.engine = Engine(self.crypto.pub_key, self.brain, self.crypto,
-                             self.wal, frontier=self.frontier, tracer=tracer)
+                             self.wal, frontier=self.frontier, tracer=tracer,
+                             metrics=metrics, recorder=recorder)
         #: Last applied configuration (reference `reconfigure:
         #: Arc<RwLock<Option<ConsensusConfiguration>>>`, src/consensus.rs:55).
         self.reconfigure: Optional[pb2.ConsensusConfiguration] = None

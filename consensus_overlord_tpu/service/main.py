@@ -35,8 +35,8 @@ from typing import Optional
 
 from .. import __version__
 from ..crypto.provider import load_private_key
-from ..obs import (JaegerExporter, Metrics, TraceContextInterceptor,
-                   init_logging)
+from ..obs import (FlightRecorder, JaegerExporter, Metrics,
+                   TraceContextInterceptor, init_logging)
 from .config import ConsensusConfig
 from .consensus import Consensus
 from .rpc import Code
@@ -59,6 +59,8 @@ class ServiceRuntime:
         set_proto_compat(config.proto_compat)
         self.metrics = (Metrics(config.metrics_buckets)
                         if config.enable_metrics else None)
+        self.recorder = (FlightRecorder(config.flight_recorder_capacity)
+                         if config.flight_recorder_capacity > 0 else None)
         # Jaeger span export when the config names an agent (reference
         # src/main.rs:173-175, example/config.toml:14); spans still get
         # context-propagated without it.
@@ -77,7 +79,29 @@ class ServiceRuntime:
         """Bring the service up; returns the bound consensus port."""
         cfg = self.config
         self.consensus = Consensus(cfg, self._private_key,
-                                   tracer=self.tracer)
+                                   tracer=self.tracer,
+                                   metrics=self.metrics,
+                                   recorder=self.recorder)
+        if self.metrics is not None:
+            # /statusz sections: live engine position, frontier batch
+            # shape, and the flight-recorder tail (newest last).
+            engine = self.consensus.engine
+            frontier = self.consensus.frontier
+            self.metrics.add_status_source("version", lambda: __version__)
+            self.metrics.add_status_source("consensus", engine.status)
+            self.metrics.add_status_source(
+                "frontier", lambda: {
+                    "requests": frontier.stats.requests,
+                    "batches": frontier.stats.batches,
+                    "mean_batch": frontier.stats.mean_batch,
+                    "max_batch": frontier.stats.max_batch,
+                    "failures": frontier.stats.failures,
+                })
+            if self.recorder is not None:
+                recorder = self.recorder
+                tail_n = cfg.statusz_tail
+                self.metrics.add_status_source(
+                    "flightrec", lambda: recorder.tail(tail_n))
         interceptors = [TraceContextInterceptor(exporter=self.tracer)]
         if self.metrics is not None:
             interceptors.append(self.metrics.interceptor())
@@ -103,7 +127,8 @@ class ServiceRuntime:
         logger.info("registered with network service")
 
         if self.metrics is not None:
-            self.metrics_port = self.metrics.start_exporter(cfg.metrics_port)
+            self.metrics_port = self.metrics.start_exporter(
+                cfg.metrics_port, statusz_public=cfg.statusz_public)
             logger.info("metrics exporter on port %d", self.metrics_port)
 
         self._tasks.append(asyncio.get_running_loop().create_task(

@@ -72,17 +72,24 @@ class SimNode:
     def __init__(self, crypto: CryptoProvider, router: Router,
                  controller: SimController, wal: Optional[Wal] = None,
                  use_frontier: bool = False, frontier_max_batch: int = 1024,
-                 frontier_linger_s: float = 0.002):
+                 frontier_linger_s: float = 0.002, metrics=None,
+                 recorder=None):
         from ..crypto.frontier import BatchingVerifier
 
         self.crypto = crypto
-        self.wal = wal if wal is not None else MemoryWal()
+        self.wal = wal if wal is not None else MemoryWal(metrics=metrics)
         self.adapter = SimAdapter(crypto.pub_key, router, controller)
         self.frontier = (BatchingVerifier(crypto, frontier_max_batch,
-                                          frontier_linger_s)
+                                          frontier_linger_s, metrics=metrics)
                          if use_frontier else None)
+        self.recorder = recorder
+        if metrics is not None:
+            bind = getattr(crypto, "bind_metrics", None)
+            if bind is not None:
+                bind(metrics)
         self.engine = Engine(crypto.pub_key, self.adapter, crypto, self.wal,
-                             frontier=self.frontier)
+                             frontier=self.frontier, metrics=metrics,
+                             recorder=recorder)
         self.router = router
         self._task: Optional[asyncio.Task] = None
         router.register(crypto.pub_key, self._on_network_msg)
@@ -128,7 +135,14 @@ class SimNetwork:
                  seed: int = 0, drop_rate: float = 0.0,
                  delay_range: tuple[float, float] = (0.0, 0.0),
                  crypto_factory=None, use_frontier: bool = False,
-                 frontier_linger_s: float = 0.002):
+                 frontier_linger_s: float = 0.002, metrics=None,
+                 flight_recorder_capacity: int = 0):
+        """metrics: one shared obs.Metrics for the whole fleet (histograms
+        aggregate across nodes — fine for sim-level batch/round shape).
+        flight_recorder_capacity > 0 gives every node its own event ring;
+        dump_flight_recorders() renders them all for failure forensics."""
+        from ..obs.flightrec import FlightRecorder
+
         if crypto_factory is None:
             crypto_factory = lambda i: Ed25519Crypto(  # noqa: E731
                 i.to_bytes(4, "big") * 8)
@@ -137,11 +151,27 @@ class SimNetwork:
         cryptos = [crypto_factory(i) for i in range(n_validators)]
         self.controller = SimController(
             [c.pub_key for c in cryptos], block_interval_ms)
+        self.metrics = metrics
         self.nodes = [SimNode(c, self.router, self.controller,
                               use_frontier=use_frontier,
-                              frontier_linger_s=frontier_linger_s)
+                              frontier_linger_s=frontier_linger_s,
+                              metrics=metrics,
+                              recorder=(FlightRecorder(
+                                  flight_recorder_capacity)
+                                  if flight_recorder_capacity > 0 else None))
                       for c in cryptos]
         self.controller.on_new_height.append(self._push_status)
+
+    def dump_flight_recorders(self, n: Optional[int] = None) -> str:
+        """Every node's flight-recorder tail, labeled — attach to test
+        failures so a wedged Byzantine schedule is diagnosable post-hoc."""
+        out = []
+        for node in self.nodes:
+            if node.recorder is not None:
+                out.append(f"--- node {node.name[:4].hex()} "
+                           f"(last {n or len(node.recorder)} events) ---\n"
+                           f"{node.recorder.dump(n)}")
+        return "\n".join(out)
 
     def _push_status(self, height: int) -> None:
         """Reconfigure-push: hand every engine the next-height Status, as the

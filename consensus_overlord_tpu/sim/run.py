@@ -12,6 +12,7 @@ import argparse
 import asyncio
 import json
 import logging
+import sys
 import time
 
 
@@ -41,6 +42,12 @@ def main() -> None:
                         "this low so coalesced batches actually ride "
                         "the chip)")
     parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("--statusz-port", type=int, default=None,
+                        help="serve /metrics + /statusz on this port for "
+                        "the duration of the run (0 = OS-assigned)")
+    parser.add_argument("--flightrec", type=int, default=256,
+                        help="per-node flight-recorder capacity (events); "
+                        "rings are dumped if the run times out.  0 = off")
     parser.add_argument("--prewarm", action="store_true",
                         help="run one dummy batch through every device "
                         "kernel path BEFORE starting the fleet.  First "
@@ -112,21 +119,46 @@ def main() -> None:
               f"in {_t.time() - t0:.1f}s")
 
     async def run() -> dict:
+        from ..obs import Metrics, snapshot
+
+        metrics = Metrics()
         net = SimNetwork(n_validators=args.validators,
                          block_interval_ms=args.interval_ms,
                          drop_rate=args.drop_rate, crypto_factory=factory,
                          use_frontier=args.frontier or args.tpu,
-                         frontier_linger_s=args.frontier_linger_ms / 1000.0)
+                         frontier_linger_s=args.frontier_linger_ms / 1000.0,
+                         metrics=metrics,
+                         flight_recorder_capacity=args.flightrec)
+        statusz_port = None
+        if args.statusz_port is not None:
+            # The fleet shares one registry; statusz reports node 0's
+            # engine (all nodes track the same chain) plus every ring.
+            node0 = net.nodes[0]
+            metrics.add_status_source("consensus", node0.engine.status)
+            metrics.add_status_source(
+                "flightrec", lambda: (node0.recorder.tail(64)
+                                      if node0.recorder else []))
+            statusz_port = metrics.start_exporter(args.statusz_port,
+                                                  addr="127.0.0.1")
+            print(f"statusz: http://127.0.0.1:{statusz_port}/statusz")
         net.start(init_height=1)
         t0 = time.perf_counter()
         last = t0
         height_ms = []
-        for h in range(1, args.heights + 1):
-            await net.run_until_height(h, timeout=args.timeout)
-            now = time.perf_counter()
-            height_ms.append((now - last) * 1000)
-            print(f"height {h} committed (+{height_ms[-1]:.1f} ms)")
-            last = now
+        try:
+            for h in range(1, args.heights + 1):
+                await net.run_until_height(h, timeout=args.timeout)
+                now = time.perf_counter()
+                height_ms.append((now - last) * 1000)
+                print(f"height {h} committed (+{height_ms[-1]:.1f} ms)")
+                last = now
+        except Exception:
+            if args.flightrec:
+                print(net.dump_flight_recorders(64), file=sys.stderr)
+            raise
+        finally:
+            if statusz_port is not None:
+                metrics.stop_exporter()
         total = time.perf_counter() - t0
         await net.stop()
         srt = sorted(height_ms)
@@ -145,6 +177,12 @@ def main() -> None:
                     sum(s.requests for s in stats) / max(1, batches), 1),
                 "frontier_max_batch": max(s.max_batch for s in stats),
             }
+        # Scrape the fleet's shared registry into the summary: count/sum
+        # pairs are enough to reconstruct means; full bucket detail stays
+        # on /metrics.
+        scraped = snapshot(metrics.registry)
+        obs = {k: v for k, v in scraped.items()
+               if k.split("{", 1)[0].endswith(("_count", "_sum", "_total"))}
         return {
             "metric": "consensus-rounds",
             "validators": args.validators,
@@ -158,6 +196,7 @@ def main() -> None:
             "delivered": net.router.delivered,
             "dropped": net.router.dropped,
             **frontier,
+            "metrics": obs,
         }
 
     print(json.dumps(asyncio.run(run())))

@@ -131,7 +131,7 @@ class _Adapter:
         pass
 
 
-async def one_round(provider, pks, sigs, vote, rep):
+async def one_round(provider, pks, sigs, vote, rep, metrics=None):
     from consensus_overlord_tpu.core.sm3 import sm3_hash
     from consensus_overlord_tpu.core.types import Node, SignedVote
     from consensus_overlord_tpu.crypto.frontier import BatchingVerifier
@@ -140,8 +140,10 @@ async def one_round(provider, pks, sigs, vote, rep):
 
     authorities = [Node(pk) for pk in pks]
     adapter = _Adapter(sm3_hash(CONTENT))
-    frontier = BatchingVerifier(provider, max_batch=2048, linger_s=0.005)
-    eng = Engine(pks[0], adapter, provider, MemoryWal(), frontier=frontier)
+    frontier = BatchingVerifier(provider, max_batch=2048, linger_s=0.005,
+                                metrics=metrics)
+    eng = Engine(pks[0], adapter, provider, MemoryWal(metrics=metrics),
+                 frontier=frontier, metrics=metrics)
     eng.leader = lambda h, r: eng.name  # pin the leader schedule (see module doc)
     # Huge interval: phase timers must sit far beyond any first-touch
     # kernel compile absorbed by rep 0 (a mid-compile PROPOSE timeout
@@ -214,14 +216,25 @@ async def main():
           flush=True)
 
     for n in SCALES:
+        # Fresh registry per scale: the emitted histograms describe THIS
+        # scale's batch shape, not a mix across the sweep.  The provider
+        # binds to it too (dispatch-phase split: prep/dispatch/readback/
+        # pairing).
+        from consensus_overlord_tpu.obs import Metrics, snapshot
+        metrics = Metrics()
+        provider.bind_metrics(None)  # rep 0 (compiles) runs unmetered
+
         lat, fstats = [], []
         qc_payload = None
         # rep 0 absorbs first-touch compiles for this scale's rungs and
-        # is reported separately.
+        # is reported separately — it runs unmetered (a compile-inflated
+        # dispatch phase would dominate every histogram).
         for rep in range(ROUNDS + 1):
             dt, qc_payload, st = await one_round(
-                provider, pks[:n], sigs[:n], vote, rep)
+                provider, pks[:n], sigs[:n], vote, rep,
+                metrics=metrics if rep > 0 else None)
             if rep == 0:
+                provider.bind_metrics(metrics)  # compiles are done now
                 first = dt
             else:
                 lat.append(dt)
@@ -240,6 +253,13 @@ async def main():
                   f"({q} voters)", file=sys.stderr, flush=True)
 
         batches = [s.batches for s in fstats]
+        # Registry scrape: the frontier/device histograms (batch sizes,
+        # occupancy, queue wait, dispatch phases, round durations) ride
+        # along in the BENCH_* JSON so batch-shape drift is visible in
+        # the ledger, not just the p50s.
+        shape = snapshot(metrics.registry, prefix="frontier")
+        shape.update(snapshot(metrics.registry, prefix="crypto_dispatch"))
+        shape.update(snapshot(metrics.registry, prefix="consensus_round"))
         print(json.dumps({
             "metric": "consensus_round_p50_ms", "validators": n,
             "rounds": ROUNDS,
@@ -250,6 +270,7 @@ async def main():
             "frontier_batches_per_round":
                 round(sum(batches) / len(batches), 1),
             "pubkey_cache_fill_s": round(t_pk, 1),
+            "metrics": shape,
         }), flush=True)
 
 

@@ -1,0 +1,417 @@
+"""Observability layer: frontier/engine/WAL metrics, the flight
+recorder, the /statusz endpoint, real gRPC status codes in the RPC
+counter, and the compile-cache satellites (model-name fingerprint,
+prune-only-default-root)."""
+
+import asyncio
+import json
+import os
+import tempfile
+import unittest
+import urllib.error
+import urllib.request
+from unittest import mock
+
+import grpc
+
+from consensus_overlord_tpu import compile_cache
+from consensus_overlord_tpu.core.sm3 import sm3_hash
+from consensus_overlord_tpu.core.types import Node, VoteType
+from consensus_overlord_tpu.crypto.frontier import BatchingVerifier
+from consensus_overlord_tpu.crypto.provider import CpuBlsCrypto
+from consensus_overlord_tpu.engine.smr import Engine
+from consensus_overlord_tpu.engine.wal import FileWal, MemoryWal
+from consensus_overlord_tpu.obs import FlightRecorder, Metrics, snapshot
+from consensus_overlord_tpu.service.pb import pb2
+from consensus_overlord_tpu.service.rpc import (
+    HEALTH_SERVICE,
+    RetryClient,
+    generic_handler,
+)
+from consensus_overlord_tpu.sim.harness import SimNetwork
+
+from test_byzantine import EngineHarness, StubAdapter  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class BlsEngineHarness(EngineHarness):
+    """test_byzantine's EngineHarness over the dependency-free CPU BLS
+    provider (Ed25519Crypto needs the absent `cryptography` package)."""
+
+    def __init__(self):
+        cryptos = [CpuBlsCrypto(0x5EED + 31 * i) for i in range(4)]
+        cryptos.sort(key=lambda c: c.pub_key)
+        self.cryptos = cryptos
+        self.by_addr = {c.pub_key: c for c in cryptos}
+        self.nodes = [Node(c.pub_key) for c in cryptos]
+        self.adapter = StubAdapter()
+        self.engine = Engine(cryptos[0].pub_key, self.adapter,
+                             cryptos[0], MemoryWal())
+
+
+# ---------------------------------------------------------------------------
+# frontier metrics
+# ---------------------------------------------------------------------------
+
+class FrontierMetrics(unittest.TestCase):
+    def test_flush_observes_batch_shape_and_failures(self):
+        """Every flush lands in frontier_batch_size; each request's wait
+        lands in frontier_queue_wait_ms; a bad signature counts into
+        frontier_verify_failures_total under its message type."""
+        async def main():
+            crypto = CpuBlsCrypto(0xC0FFEE)
+            m = Metrics()
+            fr = BatchingVerifier(crypto, max_batch=64, linger_s=0.005,
+                                  metrics=m)
+            h = sm3_hash(b"payload")
+            good = crypto.sign(h)
+            bad = bytes([good[0] ^ 1]) + good[1:]
+            results = await asyncio.gather(
+                fr.verify(good, h, crypto.pub_key, msg_type="SignedVote"),
+                fr.verify(good, h, crypto.pub_key, msg_type="SignedVote"),
+                fr.verify(bad, h, crypto.pub_key, msg_type="SignedChoke"))
+            fr.close()
+            self.assertEqual(results, [True, True, False])
+            s = snapshot(m.registry)
+            self.assertGreaterEqual(s["frontier_batch_size_count"], 1)
+            self.assertEqual(s["frontier_batch_size_sum"], 3)
+            self.assertEqual(s["frontier_queue_wait_ms_count"], 3)
+            self.assertEqual(
+                s["frontier_verify_failures_total{msg_type=SignedChoke}"],
+                1)
+            self.assertNotIn(
+                "frontier_verify_failures_total{msg_type=SignedVote}", s)
+        run(main())
+
+    def test_provider_error_counts_once_not_per_lane(self):
+        """An infra error (provider raises) must land ONCE under
+        msg_type="batch_error", never inflate the per-type counters."""
+        class Exploding:
+            def verify_batch(self, sigs, hashes, voters):
+                raise RuntimeError("device fell over")
+
+        async def main():
+            m = Metrics()
+            fr = BatchingVerifier(Exploding(), max_batch=4,
+                                  linger_s=0.001, metrics=m)
+            results = await asyncio.gather(
+                *(fr.verify(b"s", b"h", b"v", msg_type="SignedVote")
+                  for _ in range(3)))
+            fr.close()
+            self.assertEqual(results, [False, False, False])
+            s = snapshot(m.registry)
+            self.assertEqual(
+                s["frontier_verify_failures_total{msg_type=batch_error}"],
+                1)
+            self.assertNotIn(
+                "frontier_verify_failures_total{msg_type=SignedVote}", s)
+        run(main())
+
+    def test_occupancy_observed_where_provider_pads(self):
+        """Occupancy/padded-lanes come from TpuBlsCrypto._host_prep —
+        the single point every device batch (fused or split sub-batch)
+        passes through; below-threshold host batches never observe."""
+        from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+
+        m = Metrics()
+        p = TpuBlsCrypto(0xFEED, device_threshold=2)
+        p.bind_metrics(m)
+        h = sm3_hash(b"block")
+        sigs = [p.sign(h) for _ in range(3)]
+        voters = [p.pub_key] * 3
+        p._host_prep(sigs, voters, 3)  # device prep: pads 3 → ladder 8
+        s = snapshot(m.registry)
+        self.assertEqual(s["frontier_batch_occupancy_count"], 1)
+        self.assertAlmostEqual(s["frontier_batch_occupancy_sum"], 3 / 8)
+        self.assertEqual(s["frontier_padded_lanes_total"], 5)
+        # Below the device threshold the host path runs — no padding,
+        # no occupancy observation.
+        resolve = p.verify_batch_async(sigs[:1], [h], voters[:1])
+        self.assertEqual(resolve(), [True])
+        s = snapshot(m.registry)
+        self.assertEqual(s["frontier_batch_occupancy_count"], 1)
+
+
+# ---------------------------------------------------------------------------
+# real gRPC status codes
+# ---------------------------------------------------------------------------
+
+class InterceptorCodes(unittest.TestCase):
+    def test_records_abort_code_not_binary_error(self):
+        """An aborted RPC must count under its REAL status code
+        (INVALID_ARGUMENT here), a clean return under OK."""
+        class _Health:
+            async def check(self, request, context):
+                if request.service == "abort":
+                    await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                        "bad request")
+                return pb2.HealthCheckResponse(
+                    status=pb2.HealthCheckResponse.SERVING)
+
+        async def main():
+            m = Metrics()
+            server = grpc.aio.server(interceptors=[m.interceptor()])
+            server.add_generic_rpc_handlers(
+                (generic_handler("Health", HEALTH_SERVICE, _Health()),))
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            try:
+                client = RetryClient(f"127.0.0.1:{port}", "Health",
+                                     HEALTH_SERVICE, retries=1)
+                await client.call("Check",
+                                  pb2.HealthCheckRequest(service=""))
+                with self.assertRaises(grpc.aio.AioRpcError) as ctx:
+                    await client.call(
+                        "Check", pb2.HealthCheckRequest(service="abort"))
+                self.assertEqual(ctx.exception.code(),
+                                 grpc.StatusCode.INVALID_ARGUMENT)
+                await client.close()
+            finally:
+                await server.stop(0.2)
+            s = snapshot(m.registry)
+            method = [k for k in s
+                      if k.startswith("grpc_server_handled_total")
+                      and "code=OK" in k]
+            self.assertEqual(len(method), 1)
+            self.assertEqual(s[method[0]], 1)
+            aborted = [k for k in s
+                       if k.startswith("grpc_server_handled_total")
+                       and "code=INVALID_ARGUMENT" in k]
+            self.assertEqual(len(aborted), 1)
+            self.assertEqual(s[aborted[0]], 1)
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class FlightRecorderRing(unittest.TestCase):
+    def test_bounded_ring_and_tail_order(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record("tick", i=i)
+        self.assertEqual(len(rec), 4)
+        tail = rec.tail()
+        self.assertEqual([e["i"] for e in tail], [6, 7, 8, 9])
+        self.assertEqual([e["i"] for e in rec.tail(2)], [8, 9])
+        self.assertEqual(rec.tail(0), [])  # 0 = none, not everything
+        dump = rec.dump()
+        self.assertIn("tick", dump)
+        self.assertIn("i=9", dump)
+
+    def test_byzantine_rejection_recorded_and_dumpable(self):
+        """A forged QC leaves a qc_rejected event in the ring — the
+        post-mortem trail for a Byzantine test failure."""
+        async def main():
+            h = BlsEngineHarness()
+            h.engine.recorder = FlightRecorder(64)
+            await h.start(height=1)
+            h.engine.handler.send_msg(h.signed_proposal(1))
+            await h.settle(0.5)  # pure-python BLS verify needs headroom
+            h.engine.handler.send_msg(
+                h.qc(1, 0, VoteType.PRECOMMIT, h.adapter.block_hash,
+                     forge_sig=True))
+            await h.settle(1.0)
+            self.assertEqual(h.adapter.commits, [])
+            kinds = [e["kind"] for e in h.engine.recorder.tail()]
+            self.assertIn("enter_round", kinds)
+            self.assertIn("qc_rejected", kinds)
+            dump = h.engine.recorder.dump()
+            self.assertIn("qc_rejected", dump)
+            self.assertIn("vote_type='PRECOMMIT'", dump)
+            await h.stop()
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# statusz endpoint
+# ---------------------------------------------------------------------------
+
+class Statusz(unittest.TestCase):
+    def test_statusz_json_shape_and_metrics_coexist(self):
+        m = Metrics()
+        rec = FlightRecorder(16)
+        rec.record("enter_round", height=3, round=1)
+        m.add_status_source("consensus",
+                            lambda: {"height": 3, "round": 1,
+                                     "leader": "ab12"})
+        m.add_status_source("flightrec", lambda: rec.tail(8))
+        m.add_status_source("broken", lambda: 1 / 0)
+        m.frontier_batch_size.observe(7)
+        port = m.start_exporter(0, addr="127.0.0.1")
+        try:
+            def get(path):
+                return urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5)
+
+            doc = json.load(get("/statusz"))
+            self.assertEqual(doc["consensus"]["height"], 3)
+            self.assertEqual(doc["consensus"]["round"], 1)
+            self.assertEqual(doc["flightrec"][-1]["kind"], "enter_round")
+            self.assertIn("error", doc["broken"])  # degraded, not down
+            doc2 = json.load(get("/debug/vars"))
+            self.assertEqual(doc2["consensus"]["leader"], "ab12")
+            body = get("/metrics").read()
+            self.assertIn(b"frontier_batch_size_bucket", body)
+            with self.assertRaises(urllib.error.HTTPError) as ctx:
+                get("/nonexistent")
+            self.assertEqual(ctx.exception.code, 404)
+        finally:
+            m.stop_exporter()
+
+    def test_statusz_loopback_gate(self):
+        """statusz is loopback-only by default — remote peers get the
+        403, loopback (incl. v4-mapped v6) passes."""
+        from consensus_overlord_tpu.obs.metrics import _loopback
+        self.assertTrue(_loopback("127.0.0.1"))
+        self.assertTrue(_loopback("127.0.0.53"))
+        self.assertTrue(_loopback("::1"))
+        self.assertTrue(_loopback("::ffff:127.0.0.1"))
+        self.assertFalse(_loopback("10.0.0.7"))
+        self.assertFalse(_loopback("::ffff:10.0.0.7"))
+        self.assertFalse(_loopback("2001:db8::1"))
+
+
+# ---------------------------------------------------------------------------
+# WAL latency
+# ---------------------------------------------------------------------------
+
+class WalMetrics(unittest.TestCase):
+    def test_file_wal_observes_append_and_fsync(self):
+        async def main():
+            m = Metrics()
+            with tempfile.TemporaryDirectory() as tmp:
+                wal = FileWal(tmp, metrics=m)
+                await wal.save(b"state-1")
+                await wal.save(b"state-2")
+                self.assertEqual(await wal.load(), b"state-2")
+            s = snapshot(m.registry)
+            self.assertEqual(s["wal_append_ms_count"], 2)
+            self.assertEqual(s["wal_fsync_ms_count"], 2)
+            self.assertGreater(s["wal_append_ms_sum"], 0)
+        run(main())
+
+    def test_memory_wal_observes_append(self):
+        async def main():
+            m = Metrics()
+            wal = MemoryWal(metrics=m)
+            await wal.save(b"x")
+            self.assertEqual(snapshot(m.registry)["wal_append_ms_count"], 1)
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# engine metrics through the sim fleet (the acceptance-criteria path)
+# ---------------------------------------------------------------------------
+
+class SimFleetMetrics(unittest.TestCase):
+    def test_fleet_exports_round_wal_and_frontier_metrics(self):
+        """A 4-validator sim run exports non-zero frontier_batch_size,
+        round-duration, and WAL-latency metrics from one shared registry,
+        and every node's flight recorder saw its state transitions."""
+        async def main():
+            m = Metrics()
+            # interval 1 s: round timers scale off it, and pure-Python
+            # BLS on a loaded 1-core box needs the headroom to beat the
+            # timeouts (same rationale as test_service's 2 s interval).
+            net = SimNetwork(n_validators=4, block_interval_ms=1000,
+                             use_frontier=True, frontier_linger_s=0.002,
+                             crypto_factory=lambda i: CpuBlsCrypto(
+                                 0x1000 + 7919 * i),
+                             metrics=m, flight_recorder_capacity=64)
+            net.start(init_height=1)
+            await net.run_until_height(1, timeout=90)
+            # Let the fleet process the height-1 commit/status fan-out so
+            # the round-transition observations land before the scrape.
+            await asyncio.sleep(0.8)
+            await net.stop()
+            s = snapshot(m.registry)
+            self.assertGreater(s["frontier_batch_size_count"], 0)
+            self.assertGreater(s["consensus_round_duration_ms_count"], 0)
+            self.assertGreater(s["wal_append_ms_count"], 0)
+            self.assertGreater(
+                s["consensus_committed_heights_total"], 0)
+            for node in net.nodes:
+                kinds = [e["kind"] for e in node.recorder.tail()]
+                self.assertIn("enter_round", kinds)
+            dump = net.dump_flight_recorders(8)
+            self.assertIn("enter_round", dump)
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# engine GC satellite: choke-round histogram pruning
+# ---------------------------------------------------------------------------
+
+class ChokeHistGC(unittest.TestCase):
+    def test_choke_round_hist_pruned_with_live_window(self):
+        async def main():
+            h = BlsEngineHarness()
+            await h.start(height=1)
+            eng = h.engine
+            eng._choke_round_hist.update({0: 1, 3: 2, 30: 3})
+            floor_round = eng.ROUND_WINDOW + 10  # floor = 10
+            await eng._enter_round(floor_round)
+            self.assertNotIn(0, eng._choke_round_hist)
+            self.assertNotIn(3, eng._choke_round_hist)
+            self.assertIn(30, eng._choke_round_hist)
+            await h.stop()
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# compile-cache satellites
+# ---------------------------------------------------------------------------
+
+class CompileCacheSatellites(unittest.TestCase):
+    def test_fingerprint_distinguishes_cpu_models(self):
+        """Identical flags + different `model name` must land in
+        different namespaces (XLA tunes LLVM features per model)."""
+        flags = "flags\t\t: fpu vme de pse sse sse2\n"
+        with tempfile.TemporaryDirectory() as tmp:
+            a = os.path.join(tmp, "a")
+            b = os.path.join(tmp, "b")
+            c = os.path.join(tmp, "c")
+            with open(a, "w") as f:
+                f.write("model name\t: Intel(R) Xeon(R) CPU E5-2690\n"
+                        + flags)
+            with open(b, "w") as f:
+                f.write("model name\t: AMD EPYC 7B12\n" + flags)
+            with open(c, "w") as f:
+                f.write("model name\t: Intel(R) Xeon(R) CPU E5-2690\n"
+                        + flags)
+            fa = compile_cache._host_fingerprint(a)
+            fb = compile_cache._host_fingerprint(b)
+            fc = compile_cache._host_fingerprint(c)
+            self.assertNotEqual(fa, fb)
+            self.assertEqual(fa, fc)
+
+    def test_prune_legacy_never_touches_foreign_roots(self):
+        """A user-supplied shared cache root must survive enable();
+        only the repo-default root is pruned of flat legacy entries."""
+        with tempfile.TemporaryDirectory() as tmp:
+            legacy = os.path.join(tmp, "xla-cache")
+            with open(legacy, "w") as f:
+                f.write("someone else's live entry")
+            compile_cache._prune_legacy(tmp)  # foreign root: no-op
+            self.assertTrue(os.path.exists(legacy))
+            with mock.patch.object(compile_cache, "_DEFAULT_DIR", tmp):
+                compile_cache._prune_legacy(tmp)  # default root: pruned
+            self.assertFalse(os.path.exists(legacy))
+
+    def test_stats_counts_monitoring_events(self):
+        before = compile_cache.stats()
+        compile_cache._on_event("/jax/compilation_cache/cache_hits")
+        compile_cache._on_event("/jax/compilation_cache/cache_misses")
+        compile_cache._on_event("/jax/some/other/event")
+        after = compile_cache.stats()
+        self.assertEqual(after["hits"], before["hits"] + 1)
+        self.assertEqual(after["misses"], before["misses"] + 1)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -5,6 +5,7 @@ push delivery, commits end-to-end, proof audit over RPC, NotReady gate,
 module guard, health, metrics."""
 
 import asyncio
+import json
 import tempfile
 import unittest
 import urllib.request
@@ -97,6 +98,20 @@ class ServiceEndToEnd(unittest.TestCase):
                         f"http://localhost:{port}/metrics", timeout=5).read())
                 self.assertIn(b"grpc_server_handling_ms", body)
                 self.assertIn(b"ProcessNetworkMsg", body)
+                # hot-path families exported with real observations
+                self.assertIn(b"frontier_batch_size_count", body)
+                self.assertIn(b"wal_append_ms_count", body)
+
+                # -- /statusz: live height/round + flight-recorder tail ----
+                status = json.loads(await asyncio.to_thread(
+                    lambda: urllib.request.urlopen(
+                        f"http://localhost:{port}/statusz", timeout=5).read()))
+                self.assertGreaterEqual(status["consensus"]["height"], 1)
+                self.assertIn("round", status["consensus"])
+                self.assertIn("leader", status["consensus"])
+                self.assertGreaterEqual(status["frontier"]["batches"], 0)
+                kinds = [e["kind"] for e in status["flightrec"]]
+                self.assertIn("enter_round", kinds)
 
                 # every node's frontier actually batched signatures
                 stats = [rt.consensus.frontier.stats for rt in runtimes]
