@@ -38,8 +38,12 @@ ITERS = int(os.environ.get("BENCH_ITERS", "2"))  # timed iterations
 #: (tpu_provider.verify_round_multi).  The driver runs the default; the
 #: k=3 row is recorded in BASELINE.md.
 HASHES = int(os.environ.get("BENCH_HASHES", "1"))
-CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     f".bench_fixture{'' if HASHES == 1 else HASHES}.npz")
+#: Fixture cache lives under scripts/.cache (gitignored), not the repo
+#: root — bench fixtures are regenerable artifacts.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", ".cache")
+CACHE = os.path.join(
+    _CACHE_DIR, f"bench_fixture{'' if HASHES == 1 else HASHES}.npz")
 
 #: BASELINE.md "blst-equivalent single-thread verify rate" — the honest
 #: external bar (round 1 compared against the pure-Python oracle, which
@@ -68,6 +72,7 @@ def _fixture():
     sks = [0xBEEF + 97 * i for i in range(N)]
     sigs = [oracle.sign(sk, hashes[i]) for i, sk in enumerate(sks)]
     pks = [oracle.sk_to_pk(sk) for sk in sks]
+    os.makedirs(_CACHE_DIR, exist_ok=True)
     np.savez(CACHE,
              sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N, 48),
              pks=np.frombuffer(b"".join(pks), np.uint8).reshape(N, 96))

@@ -1,0 +1,153 @@
+"""Device circuit breaker: route crypto around a sick accelerator.
+
+A consensus validator must keep voting even when its TPU starts failing —
+an XLA runtime error, a wedged PJRT link, or a dying chip must degrade
+throughput, not liveness.  Every device-path result in TpuBlsCrypto has
+an exact host-oracle twin (the CPU pairing backend the batch paths
+already fall back to for small batches), so the correct degraded mode is
+always available; what's needed is the decision logic:
+
+  closed     normal operation; every device failure increments a
+             consecutive-failure count, any success resets it
+  open       after `failure_threshold` consecutive failures: all work
+             routes to the host oracle for `cooldown_s`
+  half-open  after the cooldown, exactly ONE in-flight probe is allowed
+             back onto the device; success closes the breaker, failure
+             re-opens it for another cooldown
+
+Thread-safety: `allow()` / `record_*` are called from the frontier's
+dispatch worker, its resolver threads, and reconfigure paths
+concurrently — one lock guards all state.  The half-open probe token is
+part of that state, so exactly one thread wins the probe.
+
+Observability: transitions land in crypto_breaker_transitions_total{to}
+and the crypto_breaker_open gauge (obs/metrics.py); `status()` feeds the
+/statusz "crypto" section so degraded mode is visible post-hoc.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger("consensus_overlord_tpu.breaker")
+
+__all__ = ["CircuitBreaker"]
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with half-open probes."""
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 5.0,
+                 metrics=None, recorder=None,
+                 clock=time.monotonic):
+        self.failure_threshold = max(int(failure_threshold), 1)
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_inflight = False
+        self._probe_started: Optional[float] = None
+        self.metrics = metrics
+        self.recorder = recorder
+        #: Lifetime counts, served through status().
+        self.total_failures = 0
+        self.total_fallbacks = 0
+        self.times_opened = 0
+
+    # -- decision ----------------------------------------------------------
+
+    def allow(self) -> bool:
+        """May this call use the device?  False = route to the host
+        oracle.  In half-open, only the first caller gets True (the
+        probe); everyone else stays on the host until it reports."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self.cooldown_s:
+                    self._transition(HALF_OPEN)
+                else:
+                    self.total_fallbacks += 1
+                    return False
+            # HALF_OPEN: hand out exactly one probe token.  A probe whose
+            # outcome was never reported (its resolver abandoned — e.g.
+            # the awaiting task torn down mid-restart) expires after one
+            # cooldown, so the breaker can never wedge in half-open.
+            now = self._clock()
+            if (self._probe_inflight and self._probe_started is not None
+                    and now - self._probe_started >= self.cooldown_s):
+                self._probe_inflight = False
+            if not self._probe_inflight:
+                self._probe_inflight = True
+                self._probe_started = now
+                return True
+            self.total_fallbacks += 1
+            return False
+
+    # -- outcomes ----------------------------------------------------------
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self, reason: str = "") -> None:
+        with self._lock:
+            self.total_failures += 1
+            self._probe_inflight = False
+            if self._state == HALF_OPEN:
+                # The probe failed: straight back to open, fresh cooldown.
+                self._transition(OPEN, reason)
+                return
+            self._consecutive_failures += 1
+            if (self._state == CLOSED
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._transition(OPEN, reason)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def status(self) -> dict:
+        """JSON-encodable snapshot for /statusz."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "total_failures": self.total_failures,
+                "total_fallbacks": self.total_fallbacks,
+                "times_opened": self.times_opened,
+            }
+
+    # -- internals ---------------------------------------------------------
+
+    def _transition(self, to: str, reason: str = "") -> None:
+        """Caller holds the lock."""
+        if to == self._state:
+            return
+        logger.warning("device breaker %s -> %s%s", self._state, to,
+                       f" ({reason})" if reason else "")
+        self._state = to
+        if to == OPEN:
+            self._opened_at = self._clock()
+            self._consecutive_failures = 0
+            self.times_opened += 1
+        if self.metrics is not None:
+            self.metrics.breaker_transitions.labels(to=to).inc()
+            self.metrics.breaker_open.set(1.0 if to == OPEN else 0.0)
+        if self.recorder is not None:
+            self.recorder.record("breaker_transition", to=to, reason=reason)

@@ -220,14 +220,29 @@ class BatchingVerifier:
                         (time.perf_counter() - t0) * 1000.0)
             errored = False
         except Exception:  # noqa: BLE001 — malformed input is never fatal
-            logger.exception("frontier batch verification errored")
-            results = [False] * len(batch)
-            errored = True
+            # A provider whose device path died mid-batch (and that has
+            # no internal breaker/fallback of its own): re-verify every
+            # lane on the host oracle — consensus keeps making progress
+            # on exact verdicts instead of dropping a whole batch of
+            # honest votes as if they were forged.
+            logger.exception(
+                "frontier batch verification errored; host re-verify")
             if m is not None:
-                # One event under its own label: an infra error must not
-                # masquerade as a per-message-type signature attack.
-                m.frontier_verify_failures.labels(
-                    msg_type="batch_error").inc()
+                m.host_fallbacks.labels(path="frontier_reverify").inc()
+            try:
+                results = await asyncio.to_thread(
+                    lambda: [self._provider.verify_signature(s, h, v)
+                             for s, h, v in zip(sigs, hashes, voters)])
+                errored = False
+            except Exception:  # noqa: BLE001 — even the oracle failed
+                logger.exception("frontier host re-verify errored")
+                results = [False] * len(batch)
+                errored = True
+                if m is not None:
+                    # One event under its own label: an infra error must
+                    # not masquerade as a per-message signature attack.
+                    m.frontier_verify_failures.labels(
+                        msg_type="batch_error").inc()
         self.stats.batches += 1
         self.stats.max_batch = max(self.stats.max_batch, len(batch))
         now = time.perf_counter()

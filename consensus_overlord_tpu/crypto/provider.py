@@ -182,3 +182,80 @@ class Ed25519Crypto:
         """Loop fallback for the batching-frontier interface."""
         return [self.verify_signature(s, h, v)
                 for s, h, v in zip(signatures, hashes, voters)]
+
+
+def default_sim_crypto_class():
+    """The best available fast provider for simulations: Ed25519Crypto
+    when the optional `cryptography` package is importable, else the
+    dependency-free SimHashCrypto (CI installs no `cryptography`; an
+    environment without it should lose signature realism, not the whole
+    simulation)."""
+    import importlib.util
+
+    return (Ed25519Crypto if importlib.util.find_spec("cryptography")
+            else SimHashCrypto)
+
+
+def sim_crypto(seed32: bytes):
+    """One simulation-grade provider from a 32-byte seed (see
+    default_sim_crypto_class)."""
+    return default_sim_crypto_class()(seed32)
+
+
+class SimHashCrypto:
+    """Simulation-grade provider: NOT CRYPTOGRAPHY.  A 'signature' is
+    sm3(pubkey || hash) — anyone can forge one, so this proves nothing
+    about signatures.  What it buys: microsecond sign/verify with zero
+    dependencies, so protocol-behavior simulations (chaos schedules,
+    Byzantine timing, 10k-validator floods) measure the ENGINE, not a
+    pure-Python pairing — and run in environments without the
+    `cryptography` package (CI installs none; Ed25519Crypto raises at
+    construction there).  Aggregation is concatenation, mirroring
+    Ed25519Crypto's shape so QC plumbing stays exercised."""
+
+    SIG_LEN = 32
+
+    def __init__(self, seed32: bytes):
+        self._pk = sm3_hash(b"simhash-pk:" + bytes(seed32))
+
+    @property
+    def pub_key(self) -> bytes:
+        return self._pk
+
+    def hash(self, data: bytes) -> bytes:
+        return sm3_hash(data)
+
+    def sign(self, hash32: bytes) -> bytes:
+        return sm3_hash(self._pk + bytes(hash32))
+
+    def verify_signature(self, signature: bytes, hash32: bytes,
+                         voter: bytes) -> bool:
+        return bytes(signature) == sm3_hash(bytes(voter) + bytes(hash32))
+
+    def aggregate_signatures(self, signatures: Sequence[bytes],
+                             voters: Sequence[bytes]) -> bytes:
+        if len(signatures) != len(voters):
+            raise CryptoError(
+                f"signatures x voters length mismatch "
+                f"{len(signatures)} x {len(voters)}")
+        for sig in signatures:
+            if len(sig) != self.SIG_LEN:
+                raise CryptoError("bad simhash signature length")
+        return b"".join(signatures)
+
+    def verify_aggregated_signature(self, agg_sig: bytes, hash32: bytes,
+                                    voters: Sequence[bytes]) -> bool:
+        if not voters:  # match CpuBlsCrypto: an empty QC never verifies
+            return False
+        if len(agg_sig) != self.SIG_LEN * len(voters):
+            return False
+        return all(
+            self.verify_signature(
+                agg_sig[i * self.SIG_LEN:(i + 1) * self.SIG_LEN], hash32, v)
+            for i, v in enumerate(voters))
+
+    def verify_batch(self, signatures: Sequence[bytes],
+                     hashes: Sequence[bytes],
+                     voters: Sequence[bytes]) -> List[bool]:
+        return [self.verify_signature(s, h, v)
+                for s, h, v in zip(signatures, hashes, voters)]

@@ -39,6 +39,7 @@ Signing keys stay host-side (SURVEY.md §7 hard part (e)).
 
 from __future__ import annotations
 
+import logging
 import os
 import secrets
 import threading
@@ -51,6 +52,7 @@ import numpy as np
 
 from ..compile_cache import enable as _enable_compile_cache
 from ..core.sm3 import sm3_hash
+from .breaker import CircuitBreaker
 
 # The provider's kernels are the big compiles; make sure every process
 # that imports them shares the machine-wide persistent cache.
@@ -59,6 +61,8 @@ from ..ops import bls12381_groups as dev
 from ..ops.curve import Point
 from . import bls12381 as oracle
 from .provider import CpuBlsCrypto, CryptoError
+
+logger = logging.getLogger("consensus_overlord_tpu.tpu_provider")
 
 # Batches are padded to the next size in this ladder so the number of
 # distinct jit specializations stays small.  4096 was missing through r4
@@ -268,7 +272,8 @@ class TpuBlsCrypto:
 
     def __init__(self, private_key: int, common_ref: bytes = b"",
                  device_threshold: int = 32, mesh=None,
-                 qc_device_threshold: Optional[int] = None):
+                 qc_device_threshold: Optional[int] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         """mesh: optional jax.sharding.Mesh — batches then shard across its
         devices through the parallel/sharded.py kernels (single-chip jits
         otherwise).  Pass parallel.make_mesh() to use every local device.
@@ -280,7 +285,14 @@ class TpuBlsCrypto:
         2 pairings (~100 ms total), while N per-signature verifies cost
         ~100 ms EACH — so small fleets often want verifies on device
         but QC work on host (also: each path is its own kernel set, so
-        splitting the thresholds halves the compile surface)."""
+        splitting the thresholds halves the compile surface).
+
+        breaker: device circuit breaker (crypto/breaker.py).  Every
+        device path asks it before dispatching and reports outcomes; an
+        open breaker routes everything to the host oracle, with periodic
+        half-open probes back onto the device.  Pass your own to tune
+        thresholds; the default trips after 3 consecutive device
+        failures and probes every 5 s."""
         self._cpu = CpuBlsCrypto(private_key, common_ref)
         self._common_ref = common_ref
         self._threshold = device_threshold
@@ -311,6 +323,11 @@ class TpuBlsCrypto:
         #: path (prep / readback / pairing) land in crypto_dispatch_ms.
         #: None (the default) keeps the measured bench path untouched.
         self.metrics = None
+        #: Device circuit breaker: consulted before every device
+        #: dispatch, reported to after every resolve.  An open breaker
+        #: means this provider is in degraded mode — exact results from
+        #: the host oracle, no device traffic except half-open probes.
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
 
     def bind_metrics(self, metrics) -> None:
         """Attach a metric surface (obs.Metrics).  Observations run on
@@ -318,6 +335,29 @@ class TpuBlsCrypto:
         thread-safe, and every site is guarded so an unbound provider
         pays one attribute check."""
         self.metrics = metrics
+        self.breaker.metrics = metrics
+
+    def degraded_status(self) -> dict:
+        """Breaker + fallback state for /statusz ("crypto" section)."""
+        return self.breaker.status()
+
+    def _device_allowed(self, path: str) -> bool:
+        """Ask the breaker; count the fallback when routed to host."""
+        if self.breaker.allow():
+            return True
+        if self.metrics is not None:
+            self.metrics.host_fallbacks.labels(path=path).inc()
+        return False
+
+    def _device_failed(self, path: str, exc: BaseException) -> None:
+        """One device dispatch/readback failure: feed the breaker, count
+        it, log it.  The caller then falls back to the host oracle."""
+        logger.warning("device path %s failed (%s: %s); host fallback",
+                       path, type(exc).__name__, exc)
+        self.breaker.record_failure(f"{path}: {type(exc).__name__}")
+        if self.metrics is not None:
+            self.metrics.device_failures.labels(path=path).inc()
+            self.metrics.host_fallbacks.labels(path=path).inc()
 
     def _observe_phase(self, phase: str, t0: float) -> float:
         """Observe one host-side device-path phase; returns a fresh
@@ -365,28 +405,38 @@ class TpuBlsCrypto:
             raise CryptoError(
                 f"signatures x voters length mismatch "
                 f"{len(signatures)} x {len(voters)}")
-        if len(signatures) < self._qc_threshold:
+        if (len(signatures) < self._qc_threshold
+                or not self._device_allowed("aggregate")):
             return lambda: self._cpu.aggregate_signatures(signatures, voters)
         n = len(signatures)
-        size = self._pad_to(n)
-        parsed = dev.parse_g1_compressed(list(signatures))
-        x = np.zeros((size, dev.FQ.n), np.int32)
-        x[:n] = parsed.x
-        sign_f = np.zeros(size, bool)
-        sign_f[:n] = parsed.sign
-        inf = np.zeros(size, bool)
-        inf[:n] = parsed.infinity
-        ok = np.zeros(size, bool)
-        ok[:n] = parsed.wellformed
-        out = self._kernels.g1_validate_sum(
-            jnp.asarray(x), jnp.asarray(sign_f), jnp.asarray(inf),
-            jnp.asarray(ok))
+        try:
+            size = self._pad_to(n)
+            parsed = dev.parse_g1_compressed(list(signatures))
+            x = np.zeros((size, dev.FQ.n), np.int32)
+            x[:n] = parsed.x
+            sign_f = np.zeros(size, bool)
+            sign_f[:n] = parsed.sign
+            inf = np.zeros(size, bool)
+            inf[:n] = parsed.infinity
+            ok = np.zeros(size, bool)
+            ok[:n] = parsed.wellformed
+            out = self._kernels.g1_validate_sum(
+                jnp.asarray(x), jnp.asarray(sign_f), jnp.asarray(inf),
+                jnp.asarray(ok))
+        except Exception as e:  # noqa: BLE001 — device dispatch failed
+            self._device_failed("aggregate", e)
+            return lambda: self._cpu.aggregate_signatures(signatures, voters)
 
         def resolve() -> bytes:
             # ONE device_get for the whole output tuple: each separate
             # np.asarray()/bool() on a device array is its own blocking
             # D2H round-trip (~150 ms on a remote PJRT link).
-            ax, ay, ainf, valid = jax.device_get(out)
+            try:
+                ax, ay, ainf, valid = jax.device_get(out)
+            except Exception as e:  # noqa: BLE001 — device readback failed
+                self._device_failed("aggregate", e)
+                return self._cpu.aggregate_signatures(signatures, voters)
+            self.breaker.record_success()
             if not bool(valid[:n].all()):
                 raise CryptoError("invalid signature in aggregation batch")
             return oracle.g1_compress(_affine_to_oracle_g1(ax, ay, ainf))
@@ -402,25 +452,37 @@ class TpuBlsCrypto:
         """Dispatch the QC pubkey aggregation now (device gather from the
         resident cache); returns resolve() → bool finishing host-side
         (signature decompress + 2 pairings)."""
-        if len(voters) < self._qc_threshold:
+        if (len(voters) < self._qc_threshold
+                or not self._device_allowed("verify_aggregated")):
             return lambda: self._cpu.verify_aggregated_signature(
                 agg_sig, hash32, voters)
-        idx = self._pk_rows_of(voters)
-        if (idx < 0).any():
-            # An aggregated QC over an invalid key can never verify.
-            return lambda: False
-        n = len(voters)
-        size = self._pad_to(n)
-        rows = np.zeros(size, np.int64)
-        rows[:n] = idx
-        mask = np.zeros(size, bool)
-        mask[:n] = True
-        pkx, pky, pkz = self._pk_device()
-        out = self._kernels.g2_sum_rows(
-            jnp.asarray(rows), jnp.asarray(mask), pkx, pky, pkz)
+        try:
+            idx = self._pk_rows_of(voters)
+            if (idx < 0).any():
+                # An aggregated QC over an invalid key can never verify.
+                return lambda: False
+            n = len(voters)
+            size = self._pad_to(n)
+            rows = np.zeros(size, np.int64)
+            rows[:n] = idx
+            mask = np.zeros(size, bool)
+            mask[:n] = True
+            pkx, pky, pkz = self._pk_device()
+            out = self._kernels.g2_sum_rows(
+                jnp.asarray(rows), jnp.asarray(mask), pkx, pky, pkz)
+        except Exception as e:  # noqa: BLE001 — device dispatch failed
+            self._device_failed("verify_aggregated", e)
+            return lambda: self._cpu.verify_aggregated_signature(
+                agg_sig, hash32, voters)
 
         def resolve() -> bool:
-            agg_pk = _affine_to_oracle_g2(*jax.device_get(out))
+            try:
+                agg_pk = _affine_to_oracle_g2(*jax.device_get(out))
+            except Exception as e:  # noqa: BLE001 — device readback failed
+                self._device_failed("verify_aggregated", e)
+                return self._cpu.verify_aggregated_signature(
+                    agg_sig, hash32, voters)
+            self.breaker.record_success()
             if agg_pk is None:
                 return False
             try:
@@ -469,7 +531,7 @@ class TpuBlsCrypto:
         assert len(hashes) == n and len(voters) == n
         if n == 0:
             return lambda: []
-        if n < self._threshold:
+        if n < self._threshold or not self._device_allowed("verify_batch"):
             # Host-oracle path — no device dispatch to pipeline; resolve
             # lazily so the frontier's off-loop worker pays the CPU cost.
             return lambda: [self._cpu.verify_signature(s, h, v)
@@ -479,14 +541,20 @@ class TpuBlsCrypto:
         for i, h in enumerate(hashes):
             groups.setdefault(bytes(h), []).append(i)
 
-        if len(groups) == 1:
-            t0 = time.perf_counter()
-            prep = self._host_prep(signatures, voters, n)
-            self._observe_phase("prep", t0)
-            return self._dispatch_single_hash(
-                signatures, bytes(hashes[0]), voters, n, *prep)
-        if len(groups) <= _GROUP_SIZES[-1]:
-            return self._dispatch_multi_hash(signatures, voters, n, groups)
+        try:
+            if len(groups) == 1:
+                t0 = time.perf_counter()
+                prep = self._host_prep(signatures, voters, n)
+                self._observe_phase("prep", t0)
+                return self._dispatch_single_hash(
+                    signatures, bytes(hashes[0]), voters, n, *prep)
+            if len(groups) <= _GROUP_SIZES[-1]:
+                return self._dispatch_multi_hash(signatures, voters, n,
+                                                 groups)
+        except Exception as e:  # noqa: BLE001 — device dispatch failed
+            self._device_failed("verify_batch", e)
+            return lambda: [self._cpu.verify_signature(s, h, v)
+                            for s, h, v in zip(signatures, hashes, voters)]
         # Many distinct hashes (beyond the fused-kernel ladder): verify
         # each hash group as its own single-hash sub-batch, dispatched
         # back-to-back now and resolved together.
@@ -565,7 +633,14 @@ class TpuBlsCrypto:
             # blocking D2H round-trip (~150 ms over a remote PJRT link) —
             # measured at 840 ms of the 1.1 s batch before this was fused.
             t0 = time.perf_counter()
-            ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
+            try:
+                ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
+            except Exception as e:  # noqa: BLE001 — device readback failed
+                self._device_failed("verify_batch", e)
+                return [self._cpu.verify_signature(signatures[i], h,
+                                                   voters[i])
+                        for i in range(n)]
+            self.breaker.record_success()
             t0 = self._observe_phase("readback", t0)
             v = valid[:n] & pk_ok
             if not v.any():
@@ -610,7 +685,14 @@ class TpuBlsCrypto:
 
         def resolve() -> List[bool]:
             t0 = time.perf_counter()
-            flat = jax.device_get(out)
+            try:
+                flat = jax.device_get(out)
+            except Exception as e:  # noqa: BLE001 — device readback failed
+                self._device_failed("verify_batch", e)
+                return [self._cpu.verify_signature(signatures[i],
+                                                   lane_hashes[i], voters[i])
+                        for i in range(n)]
+            self.breaker.record_success()
             t0 = self._observe_phase("readback", t0)
             ax, ay, ainf, valid = flat[:4]
             v = valid[:n] & pk_ok
@@ -688,27 +770,36 @@ class TpuBlsCrypto:
         n = len(voters)
         if n == 0:
             return
-        if n < self._qc_threshold:
+        if (n < self._qc_threshold
+                or not self._device_allowed("update_pubkeys")):
             # Small reconfigure (e.g. a 4-validator net): host validation
             # is cheaper than a device dispatch round-trip — the same
-            # threshold economics as the QC paths.
+            # threshold economics as the QC paths.  Also the degraded
+            # route when the breaker has the device fenced off.
             self._update_pubkeys_host(voters)
             return
-        size = self._pad_to(n)
-        parsed = dev.parse_g2_compressed(voters)
-        x = np.zeros((size, 2, dev.FQ.n), np.int32)
-        x[:n] = parsed.x
-        sgn = np.zeros(size, bool)
-        sgn[:n] = parsed.sign
-        inf = np.zeros(size, bool)
-        inf[:n] = parsed.infinity
-        ok = np.zeros(size, bool)
-        ok[:n] = parsed.wellformed
-        px, py, pz, valid = jax.device_get(self._kernels.g2_validate(
-            jnp.asarray(x), jnp.asarray(sgn), jnp.asarray(inf),
-            jnp.asarray(ok)))
-        aff = dev.g2_to_oracle(Point(jnp.asarray(px[:n]), jnp.asarray(py[:n]),
-                                     jnp.asarray(pz[:n])))
+        try:
+            size = self._pad_to(n)
+            parsed = dev.parse_g2_compressed(voters)
+            x = np.zeros((size, 2, dev.FQ.n), np.int32)
+            x[:n] = parsed.x
+            sgn = np.zeros(size, bool)
+            sgn[:n] = parsed.sign
+            inf = np.zeros(size, bool)
+            inf[:n] = parsed.infinity
+            ok = np.zeros(size, bool)
+            ok[:n] = parsed.wellformed
+            px, py, pz, valid = jax.device_get(self._kernels.g2_validate(
+                jnp.asarray(x), jnp.asarray(sgn), jnp.asarray(inf),
+                jnp.asarray(ok)))
+            aff = dev.g2_to_oracle(Point(jnp.asarray(px[:n]),
+                                         jnp.asarray(py[:n]),
+                                         jnp.asarray(pz[:n])))
+        except Exception as e:  # noqa: BLE001 — device validation failed
+            self._device_failed("update_pubkeys", e)
+            self._update_pubkeys_host(voters)
+            return
+        self.breaker.record_success()
         self._append_pk_rows(voters, px[:n], py[:n], pz[:n], aff, valid)
 
     def _append_pk_rows(self, voters: List[bytes], px, py, pz,

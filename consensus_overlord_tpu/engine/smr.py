@@ -361,6 +361,12 @@ class Engine:
         self._running = False
         self._mailbox.put_nowait(_Stop())
 
+    @property
+    def running(self) -> bool:
+        """Is the SMR loop live?  Read by the health service: a stopped
+        or not-yet-started engine is not a liveness stall."""
+        return self._running
+
     async def inject_inbound(self, msg) -> bool:
         """The inbound-network injection point (the reference's
         proc_network_msg tail, src/consensus.rs:214-252).  With a frontier,

@@ -26,7 +26,12 @@ multiple nodes can live in one test process):
   engine     consensus_round_duration_ms, consensus_view_changes_total
              {reason}, consensus_chokes_sent_total,
              consensus_committed_heights_total
-  wal        wal_append_ms, wal_fsync_ms
+  wal        wal_append_ms, wal_fsync_ms, wal_corruptions_total
+  degraded   crypto_device_failures_total{path},
+             crypto_host_fallbacks_total{path},
+             crypto_breaker_transitions_total{to}, crypto_breaker_open
+             — the device circuit breaker + host-oracle fallback
+             (crypto/breaker.py; frontier re-verify)
   compile    compile_cache_hits / compile_cache_misses — gauges read from
              compile_cache.stats() (a jax.monitoring listener) at scrape
 
@@ -140,6 +145,28 @@ class Metrics:
         self.wal_fsync_ms = Histogram(
             "wal_fsync_ms", "WAL fsync portion of a save (ms)",
             buckets=buckets, registry=self.registry)
+        self.wal_corruptions = Counter(
+            "wal_corruptions_total",
+            "Corrupt/torn WAL files quarantined at load",
+            registry=self.registry)
+
+        # -- degraded mode (crypto/breaker.py + frontier fallback) --------
+        self.device_failures = Counter(
+            "crypto_device_failures_total",
+            "Device dispatch/readback failures, by provider path",
+            ["path"], registry=self.registry)
+        self.host_fallbacks = Counter(
+            "crypto_host_fallbacks_total",
+            "Batches re-routed to the host oracle (degraded mode), by "
+            "provider path", ["path"], registry=self.registry)
+        self.breaker_transitions = Counter(
+            "crypto_breaker_transitions_total",
+            "Device circuit-breaker state transitions", ["to"],
+            registry=self.registry)
+        self.breaker_open = Gauge(
+            "crypto_breaker_open",
+            "1 while the device circuit breaker is open (all crypto on "
+            "the host oracle)", registry=self.registry)
 
         # -- compile cache (compile_cache.py) -----------------------------
         # Gauges read the module-level event counts at scrape time (the

@@ -22,6 +22,8 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
+import grpc
+
 from ..core.sm3 import sm3_hash
 from ..core.types import (
     Address,
@@ -34,14 +36,33 @@ from ..core.types import (
     validators_to_nodes,
 )
 from .pb import pb2
-from .rpc import Code, ControllerClient, NetworkClient
+from .rpc import Code, ControllerClient, NetworkClient, is_transient
 
 logger = logging.getLogger("consensus_overlord_tpu.brain")
 
 
 class BrainError(Exception):
     """A chain/network callback failed (reference ConsensusError::Other,
-    src/error.rs:20-44)."""
+    src/error.rs:20-44).
+
+    `transient` carries the RetryClient's transient-vs-fatal verdict
+    through to the engine: True for sibling hiccups the engine's own
+    recovery machinery (commit retry timer, next-round re-propose,
+    RichStatus resync) will clear; False for contract violations —
+    mis-wired ports, a protocol mismatch — where every retry will fail
+    identically and the log line should say so."""
+
+    def __init__(self, message: str, transient: bool = True):
+        super().__init__(message)
+        self.transient = transient
+
+
+def _wrap_rpc(op: str, e: "grpc.aio.AioRpcError") -> BrainError:
+    transient = is_transient(e.code())
+    return BrainError(
+        f"{op}: rpc {e.code().name}"
+        + ("" if transient else " (non-transient: check service wiring)"),
+        transient=transient)
 
 
 class GrpcBrain:
@@ -73,7 +94,10 @@ class GrpcBrain:
         """Controller GetProposal with the height-mismatch rejection
         (src/consensus.rs:531-535: a stale/ahead proposal is an error, the
         engine skips the round instead of proposing the wrong height)."""
-        resp = await self._controller.get_proposal()
+        try:
+            resp = await self._controller.get_proposal()
+        except grpc.aio.AioRpcError as e:
+            raise _wrap_rpc("get_proposal", e) from e
         if resp.status.code != Code.SUCCESS:
             raise BrainError(f"get_proposal status {resp.status.code}")
         if resp.proposal.height != height:
@@ -85,7 +109,10 @@ class GrpcBrain:
 
     async def check_block(self, height: int, block_hash: Hash,
                           content: bytes) -> bool:
-        code = await self._controller.check_proposal(height, content)
+        try:
+            code = await self._controller.check_proposal(height, content)
+        except grpc.aio.AioRpcError as e:
+            raise _wrap_rpc("check_proposal", e) from e
         if code != Code.SUCCESS:
             logger.warning("check_proposal failed: code %d", code)
         return code == Code.SUCCESS
@@ -94,8 +121,11 @@ class GrpcBrain:
         """CommitBlock; on success refresh the node list + pubkey cache from
         the returned configuration and hand the engine its next-height
         marching orders (src/consensus.rs:612-657)."""
-        resp = await self._controller.commit_block(
-            height, commit.content, commit.proof.encode())
+        try:
+            resp = await self._controller.commit_block(
+                height, commit.content, commit.proof.encode())
+        except grpc.aio.AioRpcError as e:
+            raise _wrap_rpc("commit_block", e) from e
         if resp.status.code != Code.SUCCESS:
             raise BrainError(f"commit_block status {resp.status.code}")
         config = resp.config
@@ -119,7 +149,10 @@ class GrpcBrain:
     async def broadcast_to_other(self, msg_type: str, payload: bytes) -> None:
         msg = pb2.NetworkMsg(module="consensus", type=msg_type, origin=0,
                              msg=payload)
-        code = await self._network.broadcast(msg)
+        try:
+            code = await self._network.broadcast(msg)
+        except grpc.aio.AioRpcError as e:
+            raise _wrap_rpc("broadcast", e) from e
         if code != Code.SUCCESS:
             raise BrainError(f"broadcast status {code}")
 
@@ -127,7 +160,10 @@ class GrpcBrain:
                                   payload: bytes) -> None:
         msg = pb2.NetworkMsg(module="consensus", type=msg_type,
                              origin=validator_to_origin(relayer), msg=payload)
-        code = await self._network.send_msg(msg)
+        try:
+            code = await self._network.send_msg(msg)
+        except grpc.aio.AioRpcError as e:
+            raise _wrap_rpc("send_msg", e) from e
         if code != Code.SUCCESS:
             raise BrainError(f"send_msg status {code}")
 

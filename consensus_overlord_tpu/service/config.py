@@ -55,6 +55,13 @@ class ConsensusConfig:
     #: Engine flight recorder (obs/flightrec.py): ring capacity in
     #: events; 0 disables recording entirely.
     flight_recorder_capacity: int = 512
+    #: Liveness window for the gRPC Health service: once the running
+    #: engine's height has not advanced for this many seconds, Health
+    #: answers NOT_SERVING (grpc-health-probe → Docker restarts the
+    #: container).  <= 0 restores the reference's unconditional SERVING
+    #: (src/health_check.rs:29-35).  Size it to several block intervals
+    #: plus worst-case view-change backoff.
+    health_stall_window_s: float = 60.0
     #: Events served in the /statusz flight-recorder tail (bounded so a
     #: scrape never ships the whole ring).
     statusz_tail: int = 64

@@ -86,7 +86,8 @@ class Consensus:
         # view changes, commits).  None everywhere = the pre-obs paths.
         self.metrics = metrics
         self.recorder = recorder
-        self.wal = FileWal(config.wal_path, metrics=metrics)
+        self.wal = FileWal(config.wal_path, metrics=metrics,
+                           recorder=recorder)
         self.brain = GrpcBrain(self.crypto, self.controller, self.network)
         # The frontier is the single inbound verification point; the engine
         # is constructed WITH it, so "inbound_verified" cannot drift from
@@ -97,6 +98,12 @@ class Consensus:
         bind = getattr(self.crypto, "bind_metrics", None)
         if bind is not None and metrics is not None:
             bind(metrics)
+        # The device breaker's transitions belong in the same event ring
+        # as the engine's (degraded mode is exactly when the post-mortem
+        # needs an interleaved timeline).
+        breaker = getattr(self.crypto, "breaker", None)
+        if breaker is not None and recorder is not None:
+            breaker.recorder = recorder
         # tracer: the engine emits height/round/QC-verify spans through the
         # same exporter the gRPC layer uses (reference #[instrument]
         # coverage, src/consensus.rs:96,143,209).

@@ -40,7 +40,7 @@ from ..obs import (FlightRecorder, JaegerExporter, Metrics,
 from .config import ConsensusConfig
 from .consensus import Consensus
 from .rpc import Code
-from .server import ConsensusServer, build_server
+from .server import ConsensusServer, HealthServer, build_server
 
 logger = logging.getLogger("consensus_overlord_tpu.main")
 
@@ -69,6 +69,7 @@ class ServiceRuntime:
                                       lc.service_name or "consensus")
                        if lc is not None and lc.agent_endpoint else None)
         self.consensus: Optional[Consensus] = None
+        self.health: Optional[HealthServer] = None
         self.bound_port: Optional[int] = None
         self.metrics_port: Optional[int] = None
         self._server = None
@@ -82,6 +83,12 @@ class ServiceRuntime:
                                    tracer=self.tracer,
                                    metrics=self.metrics,
                                    recorder=self.recorder)
+        # Liveness-aware health: NOT_SERVING once the engine's height
+        # stalls past the config window (grpc-health-probe in the Docker
+        # HEALTHCHECK then fails and the orchestrator restarts us).
+        self.health = HealthServer(
+            engine=self.consensus.engine,
+            stall_window_s=cfg.health_stall_window_s)
         if self.metrics is not None:
             # /statusz sections: live engine position, frontier batch
             # shape, and the flight-recorder tail (newest last).
@@ -89,6 +96,13 @@ class ServiceRuntime:
             frontier = self.consensus.frontier
             self.metrics.add_status_source("version", lambda: __version__)
             self.metrics.add_status_source("consensus", engine.status)
+            self.metrics.add_status_source("health", self.health.status)
+            # Degraded-mode visibility: breaker state + host-fallback
+            # counts, when the provider has a device path to degrade.
+            degraded = getattr(self.consensus.crypto, "degraded_status",
+                               None)
+            if degraded is not None:
+                self.metrics.add_status_source("crypto", degraded)
             self.metrics.add_status_source(
                 "frontier", lambda: {
                     "requests": frontier.stats.requests,
@@ -108,7 +122,7 @@ class ServiceRuntime:
         self._server, self.bound_port = build_server(
             ConsensusServer(self.consensus), port=cfg.consensus_port,
             interceptors=interceptors, host=self._host,
-            compat=cfg.proto_compat)
+            compat=cfg.proto_compat, health=self.health)
         await self._server.start()
         logger.info("grpc server on port %d", self.bound_port)
 

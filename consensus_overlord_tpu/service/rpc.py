@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Awaitable, Callable, Dict, Optional
 
 import grpc
@@ -77,6 +78,25 @@ class Code:
     NO_PROPOSAL = 5
 
 
+#: gRPC status codes worth retrying: the peer may recover (restarting
+#: sibling, overloaded server, lost race, missed deadline).  Everything
+#: else — INVALID_ARGUMENT, UNIMPLEMENTED, PERMISSION_DENIED, ... — is a
+#: contract violation that will fail identically on every retry; burning
+#: the retry budget on it just delays the engine's own recovery paths.
+TRANSIENT_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.ABORTED,
+    grpc.StatusCode.UNKNOWN,  # server-side unhandled raise: may clear
+})
+
+
+def is_transient(code) -> bool:
+    """Is this gRPC status code a retry-worthy transport/peer hiccup?"""
+    return code in TRANSIENT_CODES
+
+
 # method name → (request class, response class), per service.
 CONSENSUS_SERVICE = {
     "Reconfigure": (pb2.ConsensusConfiguration, pb2.StatusCode),
@@ -122,20 +142,33 @@ def generic_handler(service_name: str, methods: Dict[str, tuple],
 class RetryClient:
     """Async unary client for one service with bounded-retry semantics —
     the analog of the retry middleware every reference outbound call is
-    wrapped in (reference src/util.rs:20, 25-29)."""
+    wrapped in (reference src/util.rs:20, 25-29) — hardened with an
+    exponential-backoff + jitter schedule and a transient-vs-fatal
+    split: only TRANSIENT_CODES are retried (a sibling that answers
+    INVALID_ARGUMENT will answer it identically N times), and the delay
+    doubles per attempt with ±50% jitter so N restarting consensus nodes
+    don't re-dial their controller in lockstep."""
 
     def __init__(self, address: str, service_name: str,
                  methods: Dict[str, tuple], retries: int = 3,
-                 retry_delay_s: float = 0.3, compat: Optional[str] = None):
+                 retry_delay_s: float = 0.3, max_delay_s: float = 5.0,
+                 compat: Optional[str] = None):
         self._channel = grpc.aio.insecure_channel(address)
         self._retries = retries
         self._delay = retry_delay_s
+        self._max_delay = max_delay_s
+        self._rng = random.Random()  # jitter: deliberately unseeded
         self._calls = {}
         for method, (req_cls, resp_cls) in methods.items():
             self._calls[method] = self._channel.unary_unary(
                 f"/{full_service_name(service_name, compat)}/{method}",
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=resp_cls.FromString)
+
+    def _backoff_s(self, attempt: int) -> float:
+        """Exponential backoff with ±50% jitter, capped."""
+        base = min(self._delay * (2 ** attempt), self._max_delay)
+        return base * (0.5 + self._rng.random())
 
     async def call(self, method: str, request, timeout: float = 10.0):
         # Propagate the current trace over the wire (the reference's
@@ -153,10 +186,15 @@ class RetryClient:
             try:
                 return await self._calls[method](request, timeout=timeout,
                                                  metadata=metadata)
-            except grpc.aio.AioRpcError as e:  # transient transport errors
+            except grpc.aio.AioRpcError as e:
                 last_exc = e
+                if not is_transient(e.code()):
+                    raise  # fatal: identical on every retry
                 if attempt + 1 < self._retries:
-                    await asyncio.sleep(self._delay * (attempt + 1))
+                    delay = self._backoff_s(attempt)
+                    logger.debug("%s transient %s; retry %d in %.2fs",
+                                 method, e.code().name, attempt + 1, delay)
+                    await asyncio.sleep(delay)
         raise last_exc
 
     async def close(self) -> None:

@@ -10,6 +10,7 @@ the batching frontier inside the core, not here.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional, Sequence
 
 import grpc
@@ -70,12 +71,64 @@ class ConsensusServer:
 
 
 class HealthServer:
-    """Standard health service; unconditionally Serving, like the reference
-    (src/health_check.rs:29-35 — production liveness comes from
-    grpc-health-probe hitting this)."""
+    """Liveness-aware health service.  The reference answers SERVING
+    unconditionally (src/health_check.rs:29-35) — which means a node
+    whose engine has been wedged at one height for minutes still passes
+    grpc-health-probe and never gets restarted.  Here the probe carries
+    real liveness: SERVING while the engine's height advances (or hasn't
+    started yet — startup wait is not a stall), NOT_SERVING once the
+    height has sat still past `stall_window_s`, SERVING again as soon as
+    it moves.
+
+    stall_window_s <= 0 disables the check (the reference's
+    unconditional behavior).  `engine` needs only `.height` and
+    `.running` — plain attribute reads, safe from the gRPC thread."""
+
+    def __init__(self, engine=None, stall_window_s: float = 0.0,
+                 clock=time.monotonic):
+        self._engine = engine
+        self._stall_window = stall_window_s
+        self._clock = clock
+        self._last_height: Optional[int] = None
+        self._last_advance: Optional[float] = None
+
+    def stalled(self) -> bool:
+        """Has the engine's height sat still past the stall window?"""
+        eng = self._engine
+        if eng is None or self._stall_window <= 0:
+            return False
+        if not getattr(eng, "running", True):
+            # Not started (waiting for the controller's configuration) or
+            # stopped for shutdown: liveness is undefined, not stalled —
+            # reset the baseline so a later start gets a fresh window.
+            self._last_height = self._last_advance = None
+            return False
+        height, now = eng.height, self._clock()
+        if height != self._last_height or self._last_advance is None:
+            self._last_height, self._last_advance = height, now
+            return False
+        return now - self._last_advance > self._stall_window
+
+    def status(self) -> dict:
+        """JSON-encodable snapshot for /statusz."""
+        stalled = self.stalled()
+        since = (self._clock() - self._last_advance
+                 if self._last_advance is not None else 0.0)
+        return {
+            "serving": not stalled,
+            "stall_window_s": self._stall_window,
+            "height": self._last_height,
+            "height_age_s": round(since, 3),
+        }
 
     async def check(self, request: pb2.HealthCheckRequest,
                     context) -> pb2.HealthCheckResponse:
+        if self.stalled():
+            logger.warning(
+                "health: height %s stalled past %.1fs -> NOT_SERVING",
+                self._last_height, self._stall_window)
+            return pb2.HealthCheckResponse(
+                status=pb2.HealthCheckResponse.NOT_SERVING)
         return pb2.HealthCheckResponse(
             status=pb2.HealthCheckResponse.SERVING)
 
@@ -84,11 +137,14 @@ def build_server(consensus_server: ConsensusServer,
                  port: int = 0,
                  interceptors: Optional[Sequence] = None,
                  host: str = "[::]",
-                 compat: Optional[str] = None) -> tuple[grpc.aio.Server, int]:
+                 compat: Optional[str] = None,
+                 health: Optional[HealthServer] = None
+                 ) -> tuple[grpc.aio.Server, int]:
     """Assemble the three services into one grpc.aio server (reference
     src/main.rs:262-296).  Returns (server, bound_port) — port 0 lets the
     OS pick (used by tests).  compat: proto_compat mode for the served
-    method paths (None = process default)."""
+    method paths (None = process default).  health: a liveness-wired
+    HealthServer (default: one with the check disabled)."""
     server = grpc.aio.server(interceptors=list(interceptors or ()))
     server.add_generic_rpc_handlers((
         generic_handler("ConsensusService", CONSENSUS_SERVICE,
@@ -96,7 +152,8 @@ def build_server(consensus_server: ConsensusServer,
         generic_handler("NetworkMsgHandlerService",
                         NETWORK_MSG_HANDLER_SERVICE, consensus_server,
                         compat=compat),
-        generic_handler("Health", HEALTH_SERVICE, HealthServer(),
+        generic_handler("Health", HEALTH_SERVICE,
+                        health if health is not None else HealthServer(),
                         compat=compat),
     ))
     bound = server.add_insecure_port(f"{host}:{port}")

@@ -12,4 +12,5 @@ This is also the scaffold for the BASELINE.md measurement configs
 
 from .harness import SimNetwork, SimNode  # noqa: F401
 from .router import Router  # noqa: F401
-from .controller import SimController  # noqa: F401
+from .controller import SafetyViolation, SimController  # noqa: F401
+from .chaos import ChaosEvent, ChaosRunner, ChaosSchedule  # noqa: F401

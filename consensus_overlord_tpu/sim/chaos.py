@@ -1,0 +1,173 @@
+"""Deterministic chaos schedule for the sim fleet: crash-restarts,
+controller stall/error windows, and partition flips on a height timeline.
+
+SURVEY §5 names fault injection/recovery a rebuild obligation; the
+fault-tolerance machinery this exercises (WAL recovery, commit-retry,
+choke/view-change, the RichStatus resync, frontier teardown/rebuild) only
+counts as *built* once a seeded adversarial schedule drives all of it in
+one run and the fleet still reconverges with zero safety violations.
+
+Shape: `ChaosSchedule.generate(seed, ...)` derives a list of ChaosEvents
+from one RNG — same seed, same schedule — each pinned to a chain height.
+`ChaosRunner` arms itself on the controller's on_new_height callback and
+fires every event whose height has been reached:
+
+  crash      SimNode torn down abruptly (engine task cancelled, router
+             deregistered — the kill -9 analog), then restarted after
+             `duration_s` from the SAME WAL/keys/address at the
+             controller's current height (the ping_controller resume)
+  stall      every controller Brain callback blocks for the window (a
+             wedged controller: get_block times out into nil prevotes,
+             commits re-drive from the retry timer)
+  error      controller callbacks raise for the window (the error twin)
+  partition  the router isolates a minority group for the window, then
+             heals (round-skip / choke liveness on heal)
+
+The schedule never takes more than f validators down at once: chaos
+proves degraded-mode liveness, not that BFT needs quorum.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+logger = logging.getLogger("consensus_overlord_tpu.chaos")
+
+__all__ = ["ChaosEvent", "ChaosSchedule", "ChaosRunner"]
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    at_height: int          # fire when the chain first commits this height
+    kind: str               # "crash" | "stall" | "error" | "partition"
+    node: int = -1          # crash: validator index
+    duration_s: float = 0.5  # downtime / fault / partition window
+
+
+@dataclass
+class ChaosSchedule:
+    events: List[ChaosEvent] = field(default_factory=list)
+
+    @classmethod
+    def generate(cls, seed: int, heights: int, n_validators: int,
+                 crashes: int = 2, stalls: int = 1, partitions: int = 1,
+                 downtime_s: float = 0.4, window_s: float = 0.4
+                 ) -> "ChaosSchedule":
+        """Derive a schedule from one seeded RNG.  Events land on
+        distinct heights in [2, heights-1] — height 1 establishes the
+        fleet, and the last height is post-fault runway proving
+        reconvergence.  Crash targets are distinct validators, so at
+        most one is down per event window."""
+        rng = random.Random(seed)
+        # At most one crash per validator: targets are distinct, so more
+        # crash events than validators is unsatisfiable.
+        crashes = min(crashes, n_validators)
+        n_events = crashes + stalls + partitions
+        lo, hi = 2, max(heights - 1, 2)
+        span = list(range(lo, hi + 1))
+        if len(span) >= n_events:
+            slots = sorted(rng.sample(span, n_events))
+        else:  # short run: reuse heights, still deterministic
+            slots = sorted(rng.choice(span) for _ in range(n_events))
+        kinds = (["crash"] * crashes + ["stall"] * stalls
+                 + ["partition"] * partitions)
+        rng.shuffle(kinds)
+        crash_targets = rng.sample(range(n_validators), crashes)
+        events, ci = [], 0
+        for at, kind in zip(slots, kinds):
+            if kind == "crash":
+                events.append(ChaosEvent(at, "crash",
+                                         node=crash_targets[ci],
+                                         duration_s=downtime_s))
+                ci += 1
+            else:
+                events.append(ChaosEvent(at, kind, duration_s=window_s))
+        return cls(events)
+
+
+class ChaosRunner:
+    """Fires a ChaosSchedule against a live SimNetwork.
+
+    Construct AFTER net.start(); call `await drain()` once the run
+    reaches its target height so in-flight restarts/heals complete
+    before the fleet is stopped and asserted on."""
+
+    def __init__(self, net, schedule: ChaosSchedule):
+        self.net = net
+        self.schedule = schedule
+        #: Post-hoc log: one dict per fired event (run summaries embed it).
+        self.fired: List[dict] = []
+        self._pending = sorted(schedule.events, key=lambda e: e.at_height)
+        self._tasks: set = set()
+        net.controller.on_new_height.append(self._on_height)
+
+    def _on_height(self, height: int) -> None:
+        while self._pending and self._pending[0].at_height <= height:
+            ev = self._pending.pop(0)
+            task = asyncio.get_running_loop().create_task(
+                self._fire(ev, height))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _fire(self, ev: ChaosEvent, height: int) -> None:
+        entry = {"kind": ev.kind, "at_height": ev.at_height,
+                 "fired_height": height, "node": ev.node,
+                 "duration_s": ev.duration_s}
+        self.fired.append(entry)
+        logger.info("chaos: %s at height %d (node=%d, %.2fs)",
+                    ev.kind, height, ev.node, ev.duration_s)
+        try:
+            if ev.kind == "crash":
+                await self._crash_restart(ev)
+            elif ev.kind in ("stall", "error"):
+                self.net.controller.inject_fault(ev.kind, ev.duration_s)
+            elif ev.kind == "partition":
+                await self._partition_flip(ev)
+            else:
+                logger.warning("chaos: unknown event kind %r", ev.kind)
+        except Exception:  # noqa: BLE001 — chaos must not crash the run
+            logger.exception("chaos event %s failed", ev.kind)
+            entry["error"] = True
+
+    async def _crash_restart(self, ev: ChaosEvent) -> None:
+        node = self.net.nodes[ev.node]
+        if node.recorder is not None:
+            node.recorder.record("chaos_crash", node=ev.node)
+        self.net.crash_node(ev.node)
+        await asyncio.sleep(ev.duration_s)
+        revived = self.net.restart_node(ev.node)
+        if revived.recorder is not None:
+            revived.recorder.record("chaos_restart", node=ev.node,
+                                    init_height=revived.engine.height)
+
+    async def _partition_flip(self, ev: ChaosEvent) -> None:
+        """Isolate a minority (≤ f) group so the majority keeps
+        committing; heal after the window."""
+        nodes = self.net.nodes
+        f = max(1, (len(nodes) - 1) // 3)
+        minority = {nodes[i].name for i in range(f)}
+        majority = {n.name for n in nodes} - minority
+        self.net.router.set_partition(majority, minority)
+        await asyncio.sleep(ev.duration_s)
+        self.net.router.set_partition()  # heal
+
+    async def drain(self, timeout: float = 10.0) -> None:
+        """Wait for every fired event's follow-through (restarts, heals)
+        to finish.  Pending events whose heights were never reached are
+        dropped — the run decides how far the chain goes."""
+        self._pending.clear()
+        if self._tasks:
+            await asyncio.wait_for(
+                asyncio.gather(*list(self._tasks), return_exceptions=True),
+                timeout)
+
+    def summary(self) -> dict:
+        return {
+            "events_fired": len(self.fired),
+            "events_skipped": len(self._pending),
+            "events": self.fired,
+        }

@@ -45,6 +45,40 @@ class SimController:
         #: re-reconfiguring consensus after each committed block (the lagging-
         #: node resync path, reference src/main.rs:92-104 + consensus.rs:97-141)
         self.on_new_height: List = []
+        #: Safety violations recorded (then raised) by commit_block —
+        #: chaos runs assert this is empty even when the raising path was
+        #: swallowed by an engine's log-and-drop commit handler.
+        self.violations: List[str] = []
+        # Injected fault window (sim/chaos.py): while active, every Brain
+        # callback stalls ("stall") or raises ("error").
+        self._fault_mode: Optional[str] = None
+        self._fault_until: float = 0.0
+
+    # -- fault injection (sim/chaos.py) ------------------------------------
+
+    def inject_fault(self, mode: str, duration_s: float) -> None:
+        """Wedge ("stall") or break ("error") every controller callback
+        for `duration_s` from now."""
+        assert mode in ("stall", "error"), mode
+        self._fault_mode = mode
+        self._fault_until = asyncio.get_running_loop().time() + duration_s
+
+    async def _fault_gate(self) -> None:
+        """Applied at the top of every Brain callback: error-mode raises,
+        stall-mode blocks until the window closes (a wedged controller —
+        the engine's propose timers and commit-retry must carry it)."""
+        if self._fault_mode is None:
+            return
+        loop = asyncio.get_running_loop()
+        if self._fault_mode == "error":
+            if loop.time() < self._fault_until:
+                raise RuntimeError("injected controller fault (chaos)")
+            self._fault_mode = None
+            return
+        while loop.time() < self._fault_until:
+            await asyncio.sleep(
+                min(self._fault_until - loop.time(), 0.05))
+        self._fault_mode = None
 
     # -- chain side (Brain callbacks) --------------------------------------
 
@@ -54,20 +88,24 @@ class SimController:
         return rlp.encode([height, b"simulated block", b"\x00" * 32])
 
     async def get_proposal(self, height: int) -> tuple[bytes, Hash]:
+        await self._fault_gate()
         content = self.make_content(height)
         return content, sm3_hash(content)
 
     async def check_proposal(self, height: int, block_hash: Hash,
                              content: bytes) -> bool:
+        await self._fault_gate()
         return (content == self.make_content(height)
                 and block_hash == sm3_hash(content))
 
     async def commit_block(self, node: bytes, height: int,
                            commit: Commit) -> Status:
+        await self._fault_gate()
         existing = self.chain.get(height)
         if existing is not None and existing != commit.content:
-            raise SafetyViolation(
-                f"fork at height {height}: two distinct blocks committed")
+            msg = f"fork at height {height}: two distinct blocks committed"
+            self.violations.append(msg)
+            raise SafetyViolation(msg)
         if existing is None:
             self.chain[height] = commit.content
             self.proofs[height] = commit.proof.encode()

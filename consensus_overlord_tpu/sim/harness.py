@@ -9,7 +9,7 @@ import logging
 from typing import Dict, List, Optional, Sequence
 
 from ..core.types import Address, Commit, Hash, Node, Status, decode_wire_message
-from ..crypto.provider import CryptoProvider, Ed25519Crypto
+from ..crypto.provider import CryptoProvider
 from ..engine.smr import Engine
 from ..engine.wal import MemoryWal
 from ..ports import Wal
@@ -87,6 +87,9 @@ class SimNode:
             bind = getattr(crypto, "bind_metrics", None)
             if bind is not None:
                 bind(metrics)
+        breaker = getattr(crypto, "breaker", None)
+        if breaker is not None and recorder is not None:
+            breaker.recorder = recorder
         self.engine = Engine(crypto.pub_key, self.adapter, crypto, self.wal,
                              frontier=self.frontier, metrics=metrics,
                              recorder=recorder)
@@ -127,6 +130,18 @@ class SimNode:
         if self.frontier is not None:
             self.frontier.close()  # don't leak the dispatch worker thread
 
+    def crash(self) -> None:
+        """Abrupt teardown — the kill -9 analog: cancel the engine task
+        mid-flight (no graceful drain, no final WAL write beyond what
+        write-ahead already persisted) and drop off the network.  The
+        node can be rebuilt from its WAL via SimNetwork.restart_node."""
+        self.router.unregister(self.name)
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self.frontier is not None:
+            self.frontier.close()
+
 
 class SimNetwork:
     """A fleet of N in-process validators running real consensus."""
@@ -136,15 +151,24 @@ class SimNetwork:
                  delay_range: tuple[float, float] = (0.0, 0.0),
                  crypto_factory=None, use_frontier: bool = False,
                  frontier_linger_s: float = 0.002, metrics=None,
-                 flight_recorder_capacity: int = 0):
+                 flight_recorder_capacity: int = 0, wal_factory=None):
         """metrics: one shared obs.Metrics for the whole fleet (histograms
         aggregate across nodes — fine for sim-level batch/round shape).
         flight_recorder_capacity > 0 gives every node its own event ring;
-        dump_flight_recorders() renders them all for failure forensics."""
+        dump_flight_recorders() renders them all for failure forensics.
+        wal_factory(i) -> Wal gives node i a durable WAL (chaos runs pass
+        a per-node FileWal so crash-restart exercises the disk recovery
+        path); None = per-node MemoryWal."""
         from ..obs.flightrec import FlightRecorder
 
         if crypto_factory is None:
-            crypto_factory = lambda i: Ed25519Crypto(  # noqa: E731
+            # Ed25519 when the `cryptography` package is present, else
+            # the dependency-free sim-grade provider (crypto/provider.py
+            # sim_crypto) — an environment without the optional package
+            # loses signature realism, not the whole simulation.
+            from ..crypto.provider import sim_crypto
+
+            crypto_factory = lambda i: sim_crypto(  # noqa: E731
                 i.to_bytes(4, "big") * 8)
         self.router = Router(seed=seed, drop_rate=drop_rate,
                              delay_range=delay_range)
@@ -152,14 +176,19 @@ class SimNetwork:
         self.controller = SimController(
             [c.pub_key for c in cryptos], block_interval_ms)
         self.metrics = metrics
+        self._use_frontier = use_frontier
+        self._frontier_linger_s = frontier_linger_s
+        self._wal_factory = wal_factory
         self.nodes = [SimNode(c, self.router, self.controller,
+                              wal=(wal_factory(i) if wal_factory is not None
+                                   else None),
                               use_frontier=use_frontier,
                               frontier_linger_s=frontier_linger_s,
                               metrics=metrics,
                               recorder=(FlightRecorder(
                                   flight_recorder_capacity)
                                   if flight_recorder_capacity > 0 else None))
-                      for c in cryptos]
+                      for i, c in enumerate(cryptos)]
         self.controller.on_new_height.append(self._push_status)
 
     def dump_flight_recorders(self, n: Optional[int] = None) -> str:
@@ -181,6 +210,32 @@ class SimNetwork:
         for node in self.nodes:
             if node._task is not None and not node._task.done():
                 node.engine.handler.send_msg(status)
+
+    def crash_node(self, i: int) -> None:
+        """Abruptly kill validator i (engine task cancelled, off the
+        network).  Its WAL survives — restart_node resumes from it."""
+        self.nodes[i].crash()
+
+    def restart_node(self, i: int) -> SimNode:
+        """Rebuild validator i from its WAL on the same keys/address —
+        the crash-recovery path (WAL apply + controller-height init, the
+        ping_controller resume, reference src/consensus.rs:264-292).
+        A fresh FileWal re-reads the disk state the crashed life wrote;
+        without a wal_factory the old in-memory WAL object (the node's
+        'disk') carries over.  The flight recorder carries over too, so
+        post-mortems span the crash."""
+        old = self.nodes[i]
+        wal = (self._wal_factory(i) if self._wal_factory is not None
+               else old.wal)
+        node = SimNode(old.crypto, self.router, self.controller, wal=wal,
+                       use_frontier=self._use_frontier,
+                       frontier_linger_s=self._frontier_linger_s,
+                       metrics=self.metrics, recorder=old.recorder)
+        self.nodes[i] = node
+        node.start(self.controller.latest_height + 1,
+                   self.controller.block_interval_ms,
+                   self.controller.authority_list())
+        return node
 
     def start(self, init_height: int = 0) -> None:
         authority = self.controller.authority_list()

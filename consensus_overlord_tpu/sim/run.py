@@ -22,9 +22,33 @@ def main() -> None:
     parser.add_argument("--heights", type=int, default=5)
     parser.add_argument("--interval-ms", type=int, default=100)
     parser.add_argument("--drop-rate", type=float, default=0.0)
+    parser.add_argument("--seed", type=int, default=0,
+                        help="router RNG seed (drop/delay schedule); also "
+                        "the default chaos seed")
+    parser.add_argument("--chaos", action="store_true",
+                        help="run a seeded ChaosSchedule against the "
+                        "fleet: crash-restart validators from their "
+                        "FileWals mid-run, stall the controller, flip a "
+                        "partition — then assert the chain still reached "
+                        "--heights with zero safety violations")
+    parser.add_argument("--chaos-seed", type=int, default=None,
+                        help="chaos schedule seed (default: --seed)")
+    parser.add_argument("--chaos-crashes", type=int, default=2)
+    parser.add_argument("--chaos-stalls", type=int, default=1)
+    parser.add_argument("--chaos-partitions", type=int, default=1)
+    parser.add_argument("--chaos-downtime-ms", type=float, default=400.0,
+                        help="crash-to-restart window per crash event")
+    parser.add_argument("--chaos-window-ms", type=float, default=400.0,
+                        help="controller-fault / partition window length")
     parser.add_argument("--crypto",
-                        choices=["ed25519", "bls", "secp256k1", "sm2"],
-                        default="ed25519")
+                        choices=["ed25519", "bls", "secp256k1", "sm2",
+                                 "simhash"],
+                        default="ed25519",
+                        help="'simhash' is the dependency-free sim-grade "
+                        "provider (microsecond verifies, NOT real "
+                        "crypto) — the chaos lane's default choice, "
+                        "where the engine's fault machinery is the "
+                        "thing under test")
     parser.add_argument("--tpu", action="store_true",
                         help="use the device-batched provider for the "
                         "chosen scheme (batches ship to the TPU once the "
@@ -91,6 +115,11 @@ def main() -> None:
         thresh = args.device_threshold if args.tpu else 10**9
         factory = lambda i: cls(base + 7919 * i,  # noqa: E731
                                 device_threshold=thresh)
+    elif args.crypto == "simhash":
+        from ..crypto.provider import SimHashCrypto
+
+        factory = lambda i: SimHashCrypto(  # noqa: E731
+            (0x5000 + 7919 * i).to_bytes(32, "big"))
     elif args.tpu:
         from ..crypto.ed25519_tpu import Ed25519TpuCrypto
 
@@ -119,29 +148,64 @@ def main() -> None:
               f"in {_t.time() - t0:.1f}s")
 
     async def run() -> dict:
+        import tempfile
+
         from ..obs import Metrics, snapshot
 
         metrics = Metrics()
+        wal_tmp = None
+        wal_factory = None
+        if args.chaos:
+            # Durable per-node WALs: crash-restart must recover through
+            # the framed FileWal load path, not an in-memory stand-in.
+            from ..engine.wal import FileWal
+
+            wal_tmp = tempfile.TemporaryDirectory(prefix="chaos_wal_")
+            wal_factory = lambda i: FileWal(  # noqa: E731
+                f"{wal_tmp.name}/node{i}", metrics=metrics)
         net = SimNetwork(n_validators=args.validators,
                          block_interval_ms=args.interval_ms,
+                         seed=args.seed,
                          drop_rate=args.drop_rate, crypto_factory=factory,
                          use_frontier=args.frontier or args.tpu,
                          frontier_linger_s=args.frontier_linger_ms / 1000.0,
                          metrics=metrics,
-                         flight_recorder_capacity=args.flightrec)
+                         flight_recorder_capacity=args.flightrec,
+                         wal_factory=wal_factory)
         statusz_port = None
         if args.statusz_port is not None:
             # The fleet shares one registry; statusz reports node 0's
             # engine (all nodes track the same chain) plus every ring.
-            node0 = net.nodes[0]
-            metrics.add_status_source("consensus", node0.engine.status)
+            # Sources dereference net.nodes[0] at scrape time: a chaos
+            # crash-restart replaces the node object mid-run.
             metrics.add_status_source(
-                "flightrec", lambda: (node0.recorder.tail(64)
-                                      if node0.recorder else []))
+                "consensus", lambda: net.nodes[0].engine.status())
+            metrics.add_status_source(
+                "flightrec", lambda: (net.nodes[0].recorder.tail(64)
+                                      if net.nodes[0].recorder else []))
+            degraded = getattr(net.nodes[0].crypto, "degraded_status", None)
+            if degraded is not None:
+                metrics.add_status_source("crypto", degraded)
             statusz_port = metrics.start_exporter(args.statusz_port,
                                                   addr="127.0.0.1")
             print(f"statusz: http://127.0.0.1:{statusz_port}/statusz")
         net.start(init_height=1)
+        chaos = None
+        if args.chaos:
+            from .chaos import ChaosRunner, ChaosSchedule
+
+            schedule = ChaosSchedule.generate(
+                args.chaos_seed if args.chaos_seed is not None
+                else args.seed,
+                args.heights, args.validators,
+                crashes=args.chaos_crashes, stalls=args.chaos_stalls,
+                partitions=args.chaos_partitions,
+                downtime_s=args.chaos_downtime_ms / 1000.0,
+                window_s=args.chaos_window_ms / 1000.0)
+            chaos = ChaosRunner(net, schedule)
+            for ev in schedule.events:
+                print(f"chaos: {ev.kind} armed at height {ev.at_height}"
+                      + (f" (node {ev.node})" if ev.kind == "crash" else ""))
         t0 = time.perf_counter()
         last = t0
         height_ms = []
@@ -152,6 +216,14 @@ def main() -> None:
                 height_ms.append((now - last) * 1000)
                 print(f"height {h} committed (+{height_ms[-1]:.1f} ms)")
                 last = now
+            if chaos is not None:
+                await chaos.drain()
+                # The run's whole point: every injected fault recovered,
+                # the chain reached its target, and no two nodes ever
+                # committed different blocks at one height.
+                assert not net.controller.violations, (
+                    f"safety violations: {net.controller.violations}")
+                assert net.controller.latest_height >= args.heights
         except Exception:
             if args.flightrec:
                 print(net.dump_flight_recorders(64), file=sys.stderr)
@@ -161,6 +233,8 @@ def main() -> None:
                 metrics.stop_exporter()
         total = time.perf_counter() - t0
         await net.stop()
+        if wal_tmp is not None:
+            wal_tmp.cleanup()
         srt = sorted(height_ms)
 
         def pct(q: float) -> float:
@@ -183,7 +257,7 @@ def main() -> None:
         scraped = snapshot(metrics.registry)
         obs = {k: v for k, v in scraped.items()
                if k.split("{", 1)[0].endswith(("_count", "_sum", "_total"))}
-        return {
+        out = {
             "metric": "consensus-rounds",
             "validators": args.validators,
             "heights": args.heights,
@@ -198,6 +272,14 @@ def main() -> None:
             **frontier,
             "metrics": obs,
         }
+        if chaos is not None:
+            out["chaos"] = {
+                "seed": (args.chaos_seed if args.chaos_seed is not None
+                         else args.seed),
+                "safety_violations": len(net.controller.violations),
+                **chaos.summary(),
+            }
+        return out
 
     print(json.dumps(asyncio.run(run())))
 

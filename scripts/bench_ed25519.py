@@ -37,8 +37,10 @@ def main():
     from consensus_overlord_tpu.crypto.provider import Ed25519Crypto
 
     n_max = max(RUNGS)
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                         ".ed_fixture.npz")
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    cache = os.path.join(cache_dir, "ed_fixture.npz")
     h = sm3_hash(b"ed25519-bench-msg")
     if os.path.exists(cache):
         data = np.load(cache)

@@ -72,8 +72,12 @@ def fixture(n: int):
     from consensus_overlord_tpu.core.types import Vote, VoteType
     from consensus_overlord_tpu.crypto import bls12381 as oracle
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                        f".round_fixture{n}.npz")
+    # Cached under scripts/.cache (gitignored), NOT the repo root — bench
+    # fixtures are regenerable artifacts, not working-tree clutter.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, f"round_fixture{n}.npz")
     block_hash = sm3_hash(CONTENT)
     vote = Vote(1, 0, VoteType.PREVOTE, block_hash)
     vote_hash = sm3_hash(vote.encode())

@@ -1,0 +1,42 @@
+"""scripts/bench_round.py smoke execution (ISSUE 3 satellite): the
+round-latency instrument shipped twice with zero recorded runs — this
+keeps it from rotting by actually executing it, CPU-lane, at N=4.
+
+CONSENSUS_BENCH_CPU pins the JAX platform to CPU inside the script (the
+axon plugin would otherwise claim the device), and the small PAD/PK_CAP
+floors keep the kernel shapes tiny — the whole run is a few seconds."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "bench_round.py")
+
+
+def test_bench_round_executes_at_n4():
+    env = dict(os.environ)
+    env.update({
+        "CONSENSUS_BENCH_CPU": "1",
+        "JAX_PLATFORMS": "cpu",
+        "CONSENSUS_PAD_MIN": "8",
+        "CONSENSUS_PK_CAP_MIN": "256",
+    })
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "4", "1"], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"bench_round.py failed\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}")
+    # One JSON summary line per scale, with the ledger's key fields.
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, proc.stdout[-2000:]
+    summary = json.loads(lines[0])
+    assert summary["metric"] == "consensus_round_p50_ms"
+    assert summary["validators"] == 4
+    assert summary["leader_p50_ms"] > 0
+    assert summary["follower_qc_verify_p50_ms"] > 0
+    assert summary["frontier_batches_per_round"] >= 1
+    # The registry scrape rides along (batch-shape drift detection).
+    assert summary["metrics"]["frontier_batch_size_count"] >= 1

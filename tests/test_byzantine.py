@@ -28,14 +28,14 @@ from consensus_overlord_tpu.core.types import (
     Vote,
     VoteType,
 )
-from consensus_overlord_tpu.crypto.provider import Ed25519Crypto
+from consensus_overlord_tpu.crypto.provider import sim_crypto
 from consensus_overlord_tpu.engine.smr import Engine
 from consensus_overlord_tpu.engine.wal import MemoryWal
 from consensus_overlord_tpu.sim.harness import SimNetwork
 
 
 def make_cryptos(n=4):
-    return [Ed25519Crypto(i.to_bytes(4, "big") * 8) for i in range(n)]
+    return [sim_crypto(i.to_bytes(4, "big") * 8) for i in range(n)]
 
 
 class StubAdapter:
@@ -376,7 +376,7 @@ class TestVoteAttacks(unittest.TestCase):
             # The engine (leader) votes for itself, so only ONE more valid
             # vote may arrive: self + cryptos[1] + outsider = quorum iff the
             # outsider's (validly self-signed) vote is wrongly counted.
-            outsider = Ed25519Crypto(b"\x77" * 32)
+            outsider = sim_crypto(b"\x77" * 32)
             h.engine.handler.send_msg(
                 h.signed_vote(h.cryptos[1], height, 0, VoteType.PREVOTE, bh))
             h.engine.handler.send_msg(

@@ -1,11 +1,16 @@
 """Device Ed25519 batch verification vs the host `cryptography` backend
 (RFC 8032 signatures): curve ops, decompression, and the end-to-end
-batch relation with exact per-lane localization."""
+batch relation with exact per-lane localization.
+
+The batch-relation tests compare against the host backend, so they
+require the optional `cryptography` package (the curve-op tests below
+don't, and still run without it)."""
 
 import unittest
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from consensus_overlord_tpu.core.sm3 import sm3_hash
 from consensus_overlord_tpu.crypto.ed25519_tpu import Ed25519TpuCrypto
@@ -66,6 +71,9 @@ class TestEdwardsOps(unittest.TestCase):
 class TestEd25519Batch(unittest.TestCase):
     @classmethod
     def setUpClass(cls):
+        # The host twin these tests compare against IS Ed25519Crypto —
+        # the sim fallback would be circular; skip without the backend.
+        pytest.importorskip("cryptography")
         cls.cryptos = [Ed25519Crypto(bytes([i]) * 32) for i in range(1, 9)]
         cls.msgs = [sm3_hash(b"ed-batch-%d" % i) for i in range(8)]
         cls.sigs = [c.sign(m) for c, m in zip(cls.cryptos, cls.msgs)]

@@ -10,7 +10,7 @@ import pytest
 from consensus_overlord_tpu.core.bitmap import extract_voters
 from consensus_overlord_tpu.core.sm3 import sm3_hash
 from consensus_overlord_tpu.core.types import Proof, Vote, VoteType
-from consensus_overlord_tpu.crypto.provider import CpuBlsCrypto, Ed25519Crypto
+from consensus_overlord_tpu.crypto.provider import CpuBlsCrypto, sim_crypto
 from consensus_overlord_tpu.engine.smr import quorum_weight
 from consensus_overlord_tpu.engine.wal import FileWal, MemoryWal
 from consensus_overlord_tpu.sim import SimNetwork
@@ -220,7 +220,7 @@ class TestWalSemantics:
                 def report_view_change(self, height, round, reason):
                     pass
 
-            cryptos = [Ed25519Crypto(bytes([i]) * 32) for i in range(1, 5)]
+            cryptos = [sim_crypto(bytes([i]) * 32) for i in range(1, 5)]
             from consensus_overlord_tpu.core.types import validators_to_nodes
             authority = validators_to_nodes([c.pub_key for c in cryptos])
             # Pick a node that is NOT the leader of (height=5, round=0), so
@@ -321,7 +321,7 @@ class TestAuthorityRefreshOnRecovery:
             from consensus_overlord_tpu.core.types import validators_to_nodes
             from consensus_overlord_tpu.engine.smr import Engine
 
-            cryptos = [Ed25519Crypto(bytes([i]) * 32) for i in range(1, 6)]
+            cryptos = [sim_crypto(bytes([i]) * 32) for i in range(1, 6)]
             old = validators_to_nodes([c.pub_key for c in cryptos[:4]])
             new = validators_to_nodes([c.pub_key for c in cryptos[1:]])
             asked = []

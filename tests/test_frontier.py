@@ -7,7 +7,10 @@ import pytest
 from consensus_overlord_tpu.core.sm3 import sm3_hash
 from consensus_overlord_tpu.crypto.frontier import (
     BatchingVerifier, signature_claims)
-from consensus_overlord_tpu.crypto.provider import Ed25519Crypto
+from consensus_overlord_tpu.crypto.provider import (
+    default_sim_crypto_class,
+    sim_crypto,
+)
 from consensus_overlord_tpu.sim.harness import SimNetwork
 
 
@@ -15,8 +18,9 @@ def run(coro):
     return asyncio.run(coro)
 
 
-class CountingProvider(Ed25519Crypto):
-    """Ed25519 provider that records verify_batch call sizes."""
+class CountingProvider(default_sim_crypto_class()):
+    """Sim provider (Ed25519 when `cryptography` is importable) that
+    records verify_batch call sizes."""
 
     def __init__(self, seed):
         super().__init__(seed)
@@ -56,7 +60,7 @@ class TestBatching:
     def test_bad_signatures_fail_individually(self):
         async def go():
             prov = CountingProvider(b"\x03" * 32)
-            other = Ed25519Crypto(b"\x04" * 32)
+            other = sim_crypto(b"\x04" * 32)
             h = sm3_hash(b"m")
             good, bad = prov.sign(h), other.sign(h)
             fr = BatchingVerifier(prov, max_batch=64, linger_s=0.005)
