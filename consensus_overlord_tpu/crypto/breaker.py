@@ -34,7 +34,13 @@ from typing import Optional
 
 logger = logging.getLogger("consensus_overlord_tpu.breaker")
 
-__all__ = ["CircuitBreaker"]
+__all__ = ["CircuitBreaker", "InjectedDeviceFault"]
+
+
+class InjectedDeviceFault(RuntimeError):
+    """Raised by `CircuitBreaker.raise_if_injected` while a fault window
+    is armed — the chaos harness's stand-in for an XLA runtime error or
+    a torn PJRT link on the device dispatch/readback path."""
 
 CLOSED = "closed"
 OPEN = "open"
@@ -62,6 +68,12 @@ class CircuitBreaker:
         self.total_failures = 0
         self.total_fallbacks = 0
         self.times_opened = 0
+        #: Fault-injection window (sim/chaos.py `device_fault` events):
+        #: while armed, device paths that call raise_if_injected() fail,
+        #: driving the real open → fallback → half-open → closed cycle.
+        self._inject_until: Optional[float] = None
+        self._inject_min_left = 0
+        self.total_injected = 0
 
     # -- decision ----------------------------------------------------------
 
@@ -92,6 +104,67 @@ class CircuitBreaker:
                 return True
             self.total_fallbacks += 1
             return False
+
+    # -- fault injection (chaos) -------------------------------------------
+
+    def inject_faults(self, duration_s: float, min_faults: int = 0) -> None:
+        """Arm a fault window: for `duration_s` from now, every device
+        path that consults raise_if_injected() fails as if the device
+        dispatch/readback had thrown.  The breaker then runs its REAL
+        state machine — consecutive failures open it, cooldown probes
+        recover it once the window has passed.
+
+        min_faults > 0 keeps the window armed past `duration_s` until at
+        least that many faults have actually been injected — a target
+        that spends the wall-clock window crashed (or simply idle) would
+        otherwise see too few device calls to ever trip the breaker,
+        and the chaos schedule's open→half-open→closed obligation would
+        silently evaporate.  Chaos passes the breaker's own
+        failure_threshold, guaranteeing the open."""
+        with self._lock:
+            self._inject_until = self._clock() + duration_s
+            self._inject_min_left = max(int(min_faults), 0)
+        logger.warning("device breaker: fault injection armed for %.2fs"
+                       " (min_faults=%d)", duration_s, min_faults)
+        if self.recorder is not None:
+            self.recorder.record("device_fault_injected",
+                                 duration_s=duration_s,
+                                 min_faults=min_faults)
+
+    def clear_injected_faults(self) -> None:
+        with self._lock:
+            self._inject_until = None
+            self._inject_min_left = 0
+
+    def _inject_armed_locked(self) -> bool:
+        """Caller holds the lock.  Armed while the wall-clock window is
+        live OR the min-faults quota is unspent; disarms itself once
+        both are exhausted."""
+        if self._inject_until is None:
+            return False
+        if self._clock() < self._inject_until or self._inject_min_left > 0:
+            return True
+        self._inject_until = None
+        return False
+
+    @property
+    def fault_injected(self) -> bool:
+        with self._lock:
+            return self._inject_armed_locked()
+
+    def raise_if_injected(self, path: str = "") -> None:
+        """Device paths call this right after winning allow(): raises
+        InjectedDeviceFault while a fault window is armed, flowing
+        through the caller's normal device-failure handling
+        (record_failure + host-oracle fallback)."""
+        with self._lock:
+            if not self._inject_armed_locked():
+                return
+            self.total_injected += 1
+            if self._inject_min_left > 0:
+                self._inject_min_left -= 1
+        raise InjectedDeviceFault(
+            f"injected device fault ({path or 'device'})")
 
     # -- outcomes ----------------------------------------------------------
 
@@ -131,6 +204,8 @@ class CircuitBreaker:
                 "total_failures": self.total_failures,
                 "total_fallbacks": self.total_fallbacks,
                 "times_opened": self.times_opened,
+                "fault_injected": self._inject_armed_locked(),
+                "total_injected": self.total_injected,
             }
 
     # -- internals ---------------------------------------------------------

@@ -259,3 +259,93 @@ class SimHashCrypto:
                      voters: Sequence[bytes]) -> List[bool]:
         return [self.verify_signature(s, h, v)
                 for s, h, v in zip(signatures, hashes, voters)]
+
+
+class SimDeviceCrypto:
+    """A simulated device path around any host provider, gated by the
+    SAME CircuitBreaker + fault-injection machinery as TpuBlsCrypto.
+
+    The sim fleet's providers (SimHashCrypto / Ed25519Crypto) have no
+    accelerator, so the breaker's open → host-fallback → half-open →
+    closed cycle — the degraded mode the chaos `device_fault` event
+    exercises — never runs in a CPU-only chaos lane.  This wrapper
+    routes every verify/aggregate call through a fake "device" whose
+    only failure mode is the breaker's injected-fault window; the
+    device result is the exact host twin (it IS the base provider), so
+    chaos runs exercise the real decision logic (crypto/breaker.py)
+    and the real metric surface (crypto_device_failures_total /
+    host_fallbacks / breaker_transitions) with zero hardware.
+
+    Signing and hashing stay direct (keys are host-side on the real
+    provider too, SURVEY.md §7 hard part (e))."""
+
+    def __init__(self, base, breaker=None, metrics=None):
+        from .breaker import CircuitBreaker
+
+        self._base = base
+        #: Short cooldown: sim chains commit every tens of ms, so the
+        #: half-open probe must come up within a run, not after 5 s.
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=3, cooldown_s=0.25)
+        self.metrics = metrics
+
+    def bind_metrics(self, metrics) -> None:
+        self.metrics = metrics
+        self.breaker.metrics = metrics
+
+    def degraded_status(self) -> dict:
+        """Breaker + fallback state for /statusz ("crypto" section)."""
+        return self.breaker.status()
+
+    @property
+    def pub_key(self) -> bytes:
+        return self._base.pub_key
+
+    def hash(self, data: bytes) -> bytes:
+        return self._base.hash(data)
+
+    def sign(self, hash32: bytes) -> bytes:
+        return self._base.sign(hash32)
+
+    def _device_call(self, path: str, fn, *args):
+        """The TpuBlsCrypto dispatch posture in miniature: ask the
+        breaker, 'dispatch' (fault-injection window = the device
+        failing), report the outcome, fall back to the host oracle —
+        which here is the same function, so results are always exact."""
+        if not self.breaker.allow():
+            if self.metrics is not None:
+                self.metrics.host_fallbacks.labels(path=path).inc()
+            return fn(*args)
+        try:
+            self.breaker.raise_if_injected(path)
+        except Exception as e:  # noqa: BLE001 — injected device fault
+            self.breaker.record_failure(f"{path}: {type(e).__name__}")
+            if self.metrics is not None:
+                self.metrics.device_failures.labels(path=path).inc()
+                self.metrics.host_fallbacks.labels(path=path).inc()
+            return fn(*args)
+        result = fn(*args)
+        self.breaker.record_success()
+        return result
+
+    def verify_signature(self, signature: bytes, hash32: bytes,
+                         voter: bytes) -> bool:
+        return self._device_call("verify_batch", self._base.verify_signature,
+                                 signature, hash32, voter)
+
+    def aggregate_signatures(self, signatures: Sequence[bytes],
+                             voters: Sequence[bytes]) -> bytes:
+        return self._device_call("aggregate", self._base.aggregate_signatures,
+                                 signatures, voters)
+
+    def verify_aggregated_signature(self, agg_sig: bytes, hash32: bytes,
+                                    voters: Sequence[bytes]) -> bool:
+        return self._device_call("verify_aggregated",
+                                 self._base.verify_aggregated_signature,
+                                 agg_sig, hash32, voters)
+
+    def verify_batch(self, signatures: Sequence[bytes],
+                     hashes: Sequence[bytes],
+                     voters: Sequence[bytes]) -> List[bool]:
+        return self._device_call("verify_batch", self._base.verify_batch,
+                                 signatures, hashes, voters)

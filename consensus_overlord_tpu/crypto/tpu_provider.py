@@ -410,6 +410,7 @@ class TpuBlsCrypto:
             return lambda: self._cpu.aggregate_signatures(signatures, voters)
         n = len(signatures)
         try:
+            self.breaker.raise_if_injected("aggregate")
             size = self._pad_to(n)
             parsed = dev.parse_g1_compressed(list(signatures))
             x = np.zeros((size, dev.FQ.n), np.int32)
@@ -457,6 +458,7 @@ class TpuBlsCrypto:
             return lambda: self._cpu.verify_aggregated_signature(
                 agg_sig, hash32, voters)
         try:
+            self.breaker.raise_if_injected("verify_aggregated")
             idx = self._pk_rows_of(voters)
             if (idx < 0).any():
                 # An aggregated QC over an invalid key can never verify.
@@ -542,6 +544,7 @@ class TpuBlsCrypto:
             groups.setdefault(bytes(h), []).append(i)
 
         try:
+            self.breaker.raise_if_injected("verify_batch")
             if len(groups) == 1:
                 t0 = time.perf_counter()
                 prep = self._host_prep(signatures, voters, n)
@@ -779,6 +782,7 @@ class TpuBlsCrypto:
             self._update_pubkeys_host(voters)
             return
         try:
+            self.breaker.raise_if_injected("update_pubkeys")
             size = self._pad_to(n)
             parsed = dev.parse_g2_compressed(voters)
             x = np.zeros((size, 2, dev.FQ.n), np.int32)

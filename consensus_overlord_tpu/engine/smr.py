@@ -40,8 +40,9 @@ import asyncio
 import enum
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from ..core import rlp
 from ..core.bitmap import build_bitmap, extract_voters, sorted_authorities
@@ -192,6 +193,9 @@ class Engine:
     reference src/consensus.rs:352-357)."""
 
     MAX_PENDING = 4096  # future-message buffer bound
+    #: Failed duplicate-proposal signature checks allowed per round
+    #: before the equivocation detector stops paying for host verifies.
+    EQUIV_SIG_BUDGET = 4
     #: Live vote/choke state is kept only for rounds within this window
     #: of the current round.  Without it a single valid validator could
     #: spray votes/chokes for millions of distinct future rounds and
@@ -200,6 +204,9 @@ class Engine:
     #: beyond the window advances us via f+1 round-skip chokes or a QC
     #: first.  (tests/test_byzantine.py::test_round_flood_memory_bounded)
     ROUND_WINDOW = 64
+    #: Replay-detection memory: signatures of the last this-many
+    #: accepted votes/proposals (see _remember_sig).
+    SEEN_SIGS_CAP = 4096
 
     def __init__(self, name: Address, adapter: ConsensusAdapter,
                  crypto: CryptoProvider, wal: Wal,
@@ -254,6 +261,15 @@ class Engine:
         # Per-height transient state.
         self._contents: Dict[Hash, bytes] = {}
         self._proposals: Dict[int, SignedProposal] = {}
+        #: Rounds where equivocation was already counted (one count per
+        #: round — the counter must not be inflatable), and per-round
+        #: failed-verify budget: junk spamming distinct spoofed-proposer
+        #: payloads buys at most EQUIV_SIG_BUDGET host verifies per
+        #: round before detection goes quiet for that round (safety is
+        #: never budgeted — the second proposal is simply not adopted);
+        #: pre-verified inbound paths skip the budget entirely.
+        self._equiv_checked: set = set()
+        self._equiv_verifies: Dict[int, int] = {}
         self._prevotes: Dict[int, _VoteSet] = {}
         self._precommits: Dict[int, _VoteSet] = {}
         self._prevote_qcs: Dict[int, AggregatedVote] = {}
@@ -273,6 +289,17 @@ class Engine:
         #: duplicate QC broadcast or the ping_controller resync.
         self._pending_commit: Optional[Commit] = None
         self._commit_retry_timer: Optional[asyncio.TimerHandle] = None
+
+        #: Signatures of accepted votes/proposals (FIFO-bounded): a
+        #: stale message counts as a Byzantine "replay" ONLY when it is
+        #: a byte-exact duplicate of one this node already processed —
+        #: an honest straggler for a just-committed height arrives here
+        #: once, misses the set, and is dropped silently (no false
+        #: alarms in honest fleets).  Keyed by signature bytes: a replay
+        #: is byte-identical, and the signature alone pins voter +
+        #: message without hashing on the hot loop.
+        self._seen_sigs: Deque[bytes] = deque()
+        self._seen_sig_set: set = set()
 
         self._pending: List[object] = []  # future-height/round buffer
         self._timers: Dict[Step, asyncio.TimerHandle] = {}
@@ -468,6 +495,8 @@ class Engine:
     def _reset_height_state(self) -> None:
         self._contents.clear()
         self._proposals.clear()
+        self._equiv_checked.clear()
+        self._equiv_verifies.clear()
         self._prevotes.clear()
         self._precommits.clear()
         self._prevote_qcs.clear()
@@ -763,23 +792,82 @@ class Engine:
     async def _on_signed_proposal(self, sp: SignedProposal) -> None:
         p = sp.proposal
         if p.height < self.height or p.height > self.height + 1:
+            # Stale height: count as a replay only when byte-identical
+            # to a proposal this node already accepted — an honest
+            # straggler for a just-committed height is dropped silently.
+            if p.height < self.height and self._is_replay(sp.signature):
+                self._reject_byzantine("replay", msg="proposal",
+                                       at_height=p.height)
             return
         if self._buffer_future(sp, p.height, p.round):
             return
-        if p.round != self.round or p.round in self._proposals:
+        prev = self._proposals.get(p.round)
+        if prev is not None:
+            # A second, byte-distinct proposal for a round we already
+            # hold one for.  If it names the same proposer and carries a
+            # valid signature, this is cryptographic evidence of an
+            # equivocating leader (the counter must not be inflatable by
+            # unsigned junk); an identical re-send is a replay.  Host
+            # verify spend is bounded: only a FAILED check spends
+            # budget, so spoofed-proposer junk buys at most
+            # EQUIV_SIG_BUDGET verifies per round — after which
+            # detection (never safety) goes quiet for that round; a
+            # pre-verified inbound path (frontier) costs nothing and is
+            # never budget-gated, so there junk can't mask anything.
+            if (p.block_hash != prev.proposal.block_hash
+                    and p.proposer == prev.proposal.proposer
+                    and p.round not in self._equiv_checked):
+                if self.inbound_verified:
+                    verified = True
+                elif (self._equiv_verifies.get(p.round, 0)
+                      < self.EQUIV_SIG_BUDGET):
+                    verified = self.crypto.verify_signature(
+                        sp.signature, sm3_hash(p.encode()), p.proposer)
+                    if not verified:
+                        self._equiv_verifies[p.round] = \
+                            self._equiv_verifies.get(p.round, 0) + 1
+                        # Same forensic weight as junk arriving BEFORE
+                        # the real proposal (which hits the direct
+                        # signature check): counting must not depend on
+                        # message arrival order.
+                        self._reject_byzantine("bad_sig", msg="proposal",
+                                               at_round=p.round)
+                else:
+                    verified = False
+                if verified:
+                    self._equiv_checked.add(p.round)
+                    logger.warning("%s: equivocating proposal at round %d",
+                                   self._tag(), p.round)
+                    self._reject_byzantine(
+                        "equivocation", proposer=p.proposer[:4].hex(),
+                        at_round=p.round)
+            elif (p.block_hash == prev.proposal.block_hash
+                  and self._is_replay(sp.signature)):
+                self._reject_byzantine("replay", msg="proposal",
+                                       at_round=p.round)
+            return
+        if p.round != self.round:
+            if p.round < self.round and self._is_replay(sp.signature):
+                self._reject_byzantine("replay", msg="proposal",
+                                       at_round=p.round)
             return
         expected_leader = self.leader(p.height, p.round)
-        if p.proposer != expected_leader or not self._is_validator(p.proposer):
+        if not self._is_validator(p.proposer):
+            self._reject_byzantine("non_validator", msg="proposal")
+            return
+        if p.proposer != expected_leader:
             logger.warning("%s: proposal from non-leader", self._tag())
             return
         if not self.inbound_verified and not self.crypto.verify_signature(
                 sp.signature, sm3_hash(p.encode()), p.proposer):
             logger.warning("%s: bad proposal signature", self._tag())
+            self._reject_byzantine("bad_sig", msg="proposal")
             return
         if p.lock is not None and not await self._verify_lock_qc(p):
             logger.warning("%s: bad lock QC on proposal", self._tag())
             return
         self._proposals[p.round] = sp
+        self._remember_sig(sp.signature)
         self._contents[p.block_hash] = p.content
         # Lock rule (Tendermint safety): locked nodes prevote their lock
         # unless the proposal carries a polka from a later round.
@@ -813,8 +901,12 @@ class Engine:
         try:
             voters = extract_voters(self.authorities, qc.signature.address_bitmap)
         except ValueError:
+            self._reject_byzantine("bad_bitmap", qc_height=qc.height,
+                                   qc_round=qc.round)
             return False
         if self._weight_of(voters) < quorum_weight(self._total_weight()):
+            self._reject_byzantine("subquorum", qc_height=qc.height,
+                                   qc_round=qc.round, voters=len(voters))
             return False
         vote_hash = sm3_hash(qc.to_vote().encode())
         start_us = int(time.time() * 1e6)
@@ -824,6 +916,9 @@ class Engine:
         else:
             ok = self.crypto.verify_aggregated_signature(
                 qc.signature.signature, vote_hash, voters)
+        if not ok:
+            self._reject_byzantine("bad_qc_sig", qc_height=qc.height,
+                                   qc_round=qc.round, voters=len(voters))
         if self.tracer is not None:
             from ..obs.tracing import new_span_id
             self._emit_span("consensus.qc_verify", new_span_id(),
@@ -898,6 +993,12 @@ class Engine:
         (reference src/consensus.rs:397-416; SURVEY.md §3.5)."""
         v = sv.vote
         if v.height < self.height or v.height > self.height + 1:
+            # Stale height: replay only if byte-identical to a vote this
+            # node (as that round's leader) already counted — the honest
+            # 4th precommit racing a commit must not light the counter.
+            if v.height < self.height and self._is_replay(sv.signature):
+                self._reject_byzantine("replay", msg="vote",
+                                       at_height=v.height)
             return
         if self._buffer_future(sv, v.height, None):
             return
@@ -906,20 +1007,33 @@ class Engine:
         if abs(v.round - self.round) > self.ROUND_WINDOW:
             return  # outside the live-round window (memory bound)
         if not self._is_validator(sv.voter):
+            self._reject_byzantine("non_validator", msg="vote",
+                                   voter=sv.voter[:4].hex())
             return
         vote_set = (self._prevotes if v.vote_type == VoteType.PREVOTE
                     else self._precommits).setdefault(v.round, _VoteSet())
         if vote_set.qc_sent:
             return
         if sv.voter in vote_set.by_hash.get(v.block_hash, {}):
-            return  # duplicate
+            # Already counted for this round: a replay only if
+            # byte-identical to the accepted (verified) original —
+            # unsigned junk naming an honest voter must not inflate a
+            # counter attributed to that voter.
+            if self._is_replay(sv.signature):
+                self._reject_byzantine("replay", msg="vote",
+                                       voter=sv.voter[:4].hex(),
+                                       at_round=v.round)
+            return
         if not self.inbound_verified and not self.crypto.verify_signature(
                 sv.signature, sm3_hash(v.encode()), sv.voter):
             logger.warning("%s: bad vote signature from %s", self._tag(),
                            sv.voter[:4].hex())
+            self._reject_byzantine("bad_sig", msg="vote",
+                                   voter=sv.voter[:4].hex())
             return
         vote_set.add(v.block_hash, sv.voter, sv.signature,
                      self._weight_map.get(sv.voter, 0))
+        self._remember_sig(sv.signature)
         await self._try_aggregate(v.vote_type, v.round, v.block_hash, vote_set)
 
     async def _try_aggregate(self, vote_type: VoteType, round_: int,
@@ -1083,13 +1197,18 @@ class Engine:
         if c.round - self.round > self.ROUND_WINDOW:
             return  # outside the live-round window (memory bound)
         if not self._is_validator(sc.address):
+            self._reject_byzantine("non_validator", msg="choke",
+                                   voter=sc.address[:4].hex())
             return
         chokes = self._chokes.setdefault(c.round, {})
         if sc.address in chokes:
+            # NOT counted as replay: honest nodes legitimately
+            # re-broadcast their choke on every brake timeout.
             return
         if not self.inbound_verified and not self.crypto.verify_signature(
                 sc.signature, sm3_hash(c.encode()), sc.address):
             logger.warning("%s: bad choke signature", self._tag())
+            self._reject_byzantine("bad_sig", msg="choke")
             return
         chokes[sc.address] = sc.signature
         # O(1) accumulated choke weight per round (the quorum test runs
@@ -1136,6 +1255,37 @@ class Engine:
                 self.height, self.round, f"round skip to {skip_to}")
             self._note_view_change("round_skip", skip_to)
             await self._enter_round(skip_to)
+
+    def _remember_sig(self, sig: bytes) -> None:
+        """Record an accepted vote/proposal signature for replay
+        detection (bounded FIFO)."""
+        sig = bytes(sig)
+        if sig in self._seen_sig_set:
+            return
+        if len(self._seen_sigs) >= self.SEEN_SIGS_CAP:
+            self._seen_sig_set.discard(self._seen_sigs.popleft())
+        self._seen_sigs.append(sig)
+        self._seen_sig_set.add(sig)
+
+    def _is_replay(self, sig: bytes) -> bool:
+        """Was this exact signed message already processed?  Only a
+        byte-exact duplicate counts as a replay — a late-but-fresh
+        honest message never trips this."""
+        return bytes(sig) in self._seen_sig_set
+
+    def _reject_byzantine(self, reason: str, **fields) -> None:
+        """One adversarial (or adversarial-looking) message turned away
+        by a guard: count it by reason so a live adversary is visible in
+        /metrics, and drop a flight-recorder event so a wedged
+        adversarial run is diagnosable post-hoc via /statusz.  Reasons:
+        bad_qc_sig, bad_bitmap, subquorum, equivocation, replay,
+        non_validator, bad_sig."""
+        if self.metrics is not None:
+            self.metrics.byzantine_rejections.labels(reason=reason).inc()
+        if self.recorder is not None:
+            self.recorder.record("byzantine_reject", reason=reason,
+                                 height=self.height, round=self.round,
+                                 **fields)
 
     def _note_view_change(self, reason: str, to_round: int) -> None:
         if self.metrics is not None:

@@ -25,7 +25,10 @@ multiple nodes can live in one test process):
              readback (device round-trip), pairing (host pairing check)
   engine     consensus_round_duration_ms, consensus_view_changes_total
              {reason}, consensus_chokes_sent_total,
-             consensus_committed_heights_total
+             consensus_committed_heights_total,
+             consensus_byzantine_rejections_total{reason} — adversarial
+             messages the guards turned away (forged QC sigs, tampered
+             bitmaps, equivocating proposals, replays, non-validators)
   wal        wal_append_ms, wal_fsync_ms, wal_corruptions_total
   degraded   crypto_device_failures_total{path},
              crypto_host_fallbacks_total{path},
@@ -137,6 +140,12 @@ class Metrics:
         self.committed_heights = Counter(
             "consensus_committed_heights_total",
             "Heights committed by this node", registry=self.registry)
+        self.byzantine_rejections = Counter(
+            "consensus_byzantine_rejections_total",
+            "Adversarial messages rejected by the engine, by reason "
+            "(bad_qc_sig, bad_bitmap, subquorum, equivocation, replay, "
+            "non_validator, bad_sig)",
+            ["reason"], registry=self.registry)
 
         # -- WAL (engine/wal.py) ------------------------------------------
         self.wal_append_ms = Histogram(

@@ -14,3 +14,8 @@ from .harness import SimNetwork, SimNode  # noqa: F401
 from .router import Router  # noqa: F401
 from .controller import SafetyViolation, SimController  # noqa: F401
 from .chaos import ChaosEvent, ChaosRunner, ChaosSchedule  # noqa: F401
+from .adversary import (  # noqa: F401
+    AdversaryShim,
+    BEHAVIORS,
+    REJECTION_REASONS,
+)

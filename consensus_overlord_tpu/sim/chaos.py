@@ -1,51 +1,86 @@
 """Deterministic chaos schedule for the sim fleet: crash-restarts,
-controller stall/error windows, and partition flips on a height timeline.
+controller stall/error windows, partition flips, Byzantine adversary
+windows, and device-path fault injection on a height timeline.
 
 SURVEY §5 names fault injection/recovery a rebuild obligation; the
 fault-tolerance machinery this exercises (WAL recovery, commit-retry,
-choke/view-change, the RichStatus resync, frontier teardown/rebuild) only
-counts as *built* once a seeded adversarial schedule drives all of it in
-one run and the fleet still reconverges with zero safety violations.
+choke/view-change, the RichStatus resync, frontier teardown/rebuild,
+the engine's Byzantine guards, the device circuit breaker) only counts
+as *built* once a seeded adversarial schedule drives all of it in one
+run and the fleet still reconverges with zero safety violations.
 
 Shape: `ChaosSchedule.generate(seed, ...)` derives a list of ChaosEvents
 from one RNG — same seed, same schedule — each pinned to a chain height.
 `ChaosRunner` arms itself on the controller's on_new_height callback and
 fires every event whose height has been reached:
 
-  crash      SimNode torn down abruptly (engine task cancelled, router
-             deregistered — the kill -9 analog), then restarted after
-             `duration_s` from the SAME WAL/keys/address at the
-             controller's current height (the ping_controller resume)
-  stall      every controller Brain callback blocks for the window (a
-             wedged controller: get_block times out into nil prevotes,
-             commits re-drive from the retry timer)
-  error      controller callbacks raise for the window (the error twin)
-  partition  the router isolates a minority group for the window, then
-             heals (round-skip / choke liveness on heal)
+  crash        SimNode torn down abruptly (engine task cancelled, router
+               deregistered — the kill -9 analog), then restarted after
+               `duration_s` from the SAME WAL/keys/address at the
+               controller's current height (the ping_controller resume)
+  stall        every controller Brain callback blocks for the window (a
+               wedged controller: get_block times out into nil prevotes,
+               commits re-drive from the retry timer)
+  error        controller callbacks raise for the window (the error twin)
+  partition    the router isolates a minority group for the window, then
+               heals (round-skip / choke liveness on heal)
+  byzantine    an adversary behavior (sim/adversary.py: equivocator,
+               forger, withholder, replayer) is armed on a live node for
+               `heights` chain heights, then disarmed.  node=-1 defers
+               target choice to fire time: the runner picks a node that
+               will LEAD two heights out (so leader-dependent behaviors
+               actually get the ball), skipping currently-faulty nodes
+  device_fault tells the target node's crypto CircuitBreaker to fail
+               every device dispatch for `duration_s`
+               (crypto/breaker.py raise_if_injected) — the breaker must
+               open, route to the host oracle, half-open probe, and
+               close again inside the same schedule as everything else
 
-The schedule never takes more than f validators down at once: chaos
-proves degraded-mode liveness, not that BFT needs quorum.
+The f-bound invariant: the runner never lets crashed + Byzantine nodes
+exceed f = ⌊(n−1)/3⌋ concurrently (one for n=4).  An event that would
+breach it is DEFERRED one height (bounded retries), keeping schedules
+valid without making seeds fragile.  Chaos proves degraded-mode
+liveness and safety under f faults, not that BFT needs quorum;
+device_fault targets stay honest (degraded crypto, exact host-oracle
+results) and don't consume the budget.
 """
 
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import random
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+from .adversary import BEHAVIORS
 
 logger = logging.getLogger("consensus_overlord_tpu.chaos")
 
 __all__ = ["ChaosEvent", "ChaosSchedule", "ChaosRunner"]
+
+#: An event deferred this many times (f-budget never freed up / target
+#: never resolvable) is dropped with a log instead of wedging the run.
+#: Deferrals are per-height and a Byzantine window spans several
+#: heights, so a crash queued behind back-to-back adversary windows
+#: legitimately defers for tens of heights; the run's own runway cap
+#: (sim/run.py) bounds wall-clock, not this.
+MAX_DEFERS = 64
 
 
 @dataclass(frozen=True)
 class ChaosEvent:
     at_height: int          # fire when the chain first commits this height
     kind: str               # "crash" | "stall" | "error" | "partition"
-    node: int = -1          # crash: validator index
+    #                       # | "byzantine" | "device_fault"
+    node: int = -1          # crash/device_fault: validator index;
+    #                       # byzantine: -1 = runner picks an upcoming
+    #                       # leader at fire time
     duration_s: float = 0.5  # downtime / fault / partition window
+    behavior: str = ""      # byzantine: adversary behavior name
+    heights: int = 0        # byzantine: active-window length in heights
+    defers: int = 0         # times the runner pushed it back (f-bound)
 
 
 @dataclass
@@ -55,18 +90,32 @@ class ChaosSchedule:
     @classmethod
     def generate(cls, seed: int, heights: int, n_validators: int,
                  crashes: int = 2, stalls: int = 1, partitions: int = 1,
-                 downtime_s: float = 0.4, window_s: float = 0.4
-                 ) -> "ChaosSchedule":
+                 byzantine: int = 0, device_faults: int = 0,
+                 behaviors: Optional[List[str]] = None,
+                 byz_window: Optional[int] = None,
+                 downtime_s: float = 0.4, window_s: float = 0.4,
+                 device_window_s: float = 0.6) -> "ChaosSchedule":
         """Derive a schedule from one seeded RNG.  Events land on
         distinct heights in [2, heights-1] — height 1 establishes the
         fleet, and the last height is post-fault runway proving
         reconvergence.  Crash targets are distinct validators, so at
-        most one is down per event window."""
+        most one is down per event window.
+
+        byzantine: number of adversary windows; `behaviors` names them
+        explicitly (len == byzantine) or they round-robin through
+        adversary.BEHAVIORS (rejection-producing behaviors first).
+        Each window lasts `byz_window` heights (default: n_validators,
+        so a leader-dependent behavior is guaranteed its turn when the
+        window fits the run).  Targets resolve at fire time (node=-1).
+
+        The RNG draw order is append-only: a schedule generated with
+        byzantine=0 and device_faults=0 is bit-identical to one from
+        the pre-Byzantine harness (seeds stay stable across PRs)."""
         rng = random.Random(seed)
         # At most one crash per validator: targets are distinct, so more
         # crash events than validators is unsatisfiable.
         crashes = min(crashes, n_validators)
-        n_events = crashes + stalls + partitions
+        n_events = crashes + stalls + partitions + byzantine + device_faults
         lo, hi = 2, max(heights - 1, 2)
         span = list(range(lo, hi + 1))
         if len(span) >= n_events:
@@ -74,16 +123,35 @@ class ChaosSchedule:
         else:  # short run: reuse heights, still deterministic
             slots = sorted(rng.choice(span) for _ in range(n_events))
         kinds = (["crash"] * crashes + ["stall"] * stalls
-                 + ["partition"] * partitions)
+                 + ["partition"] * partitions + ["byzantine"] * byzantine
+                 + ["device_fault"] * device_faults)
         rng.shuffle(kinds)
         crash_targets = rng.sample(range(n_validators), crashes)
-        events, ci = [], 0
+        if behaviors is None:
+            behaviors = [BEHAVIORS[i % len(BEHAVIORS)]
+                         for i in range(byzantine)]
+        if len(behaviors) != byzantine:
+            raise ValueError(f"{byzantine} byzantine events but "
+                             f"{len(behaviors)} behaviors named")
+        window = byz_window if byz_window is not None \
+            else max(2, n_validators)
+        events, ci, bi = [], 0, 0
         for at, kind in zip(slots, kinds):
             if kind == "crash":
                 events.append(ChaosEvent(at, "crash",
                                          node=crash_targets[ci],
                                          duration_s=downtime_s))
                 ci += 1
+            elif kind == "byzantine":
+                events.append(ChaosEvent(at, "byzantine", node=-1,
+                                         behavior=behaviors[bi],
+                                         heights=window))
+                bi += 1
+            elif kind == "device_fault":
+                events.append(ChaosEvent(
+                    at, "device_fault",
+                    node=rng.randrange(n_validators),
+                    duration_s=device_window_s))
             else:
                 events.append(ChaosEvent(at, kind, duration_s=window_s))
         return cls(events)
@@ -93,33 +161,171 @@ class ChaosRunner:
     """Fires a ChaosSchedule against a live SimNetwork.
 
     Construct AFTER net.start(); call `await drain()` once the run
-    reaches its target height so in-flight restarts/heals complete
-    before the fleet is stopped and asserted on."""
+    reaches its target height so in-flight restarts/heals/disarms and
+    breaker recoveries complete before the fleet is stopped and
+    asserted on."""
 
     def __init__(self, net, schedule: ChaosSchedule):
         self.net = net
         self.schedule = schedule
         #: Post-hoc log: one dict per fired event (run summaries embed it).
         self.fired: List[dict] = []
+        #: Events dropped after MAX_DEFERS (f-bound never cleared).
+        self.dropped: List[dict] = []
         self._pending = sorted(schedule.events, key=lambda e: e.at_height)
         self._tasks: set = set()
+        #: node index -> "crash" | "byzantine": the live fault budget.
+        #: Invariant: len(_faulty) <= f at all times.
+        self._faulty: Dict[int, str] = {}
+        #: byzantine disarms scheduled by height: (height, node index)
+        self._disarm_at: List[tuple] = []
+        #: breakers with injected fault windows (drain waits for their
+        #: recovery so the open→half-open→closed cycle completes in-run)
+        self._breakers: List = []
+        #: events whose heights were never reached (counted at drain —
+        #: _pending is cleared there, so the summary needs the tally)
+        self._never_reached = 0
         net.controller.on_new_height.append(self._on_height)
 
+    @property
+    def pending_count(self) -> int:
+        """Events still waiting for their height (incl. f-bound
+        deferrals).  Runs that must finish the whole schedule keep
+        committing runway heights until this is zero."""
+        return len(self._pending)
+
+    @property
+    def inflight_count(self) -> int:
+        """Fired-but-unfinished event tasks.  A byzantine _fire queued
+        on the current height hasn't armed yet — runway loops must not
+        conclude the schedule is spent before it runs."""
+        return len(self._tasks)
+
+    @property
+    def byzantine_armed(self) -> bool:
+        """Any adversary window still open?  Runway heights let it
+        play out (a behavior armed but disarmed before its leader turn
+        proved nothing)."""
+        return bool(self._disarm_at)
+
+    @property
+    def f(self) -> int:
+        """Max concurrent faulty (crashed + Byzantine) nodes.  max(1,·)
+        matches the partition event's minority sizing: tiny fleets
+        still get chaos, full-size ones get the BFT bound."""
+        return max(1, (len(self.net.nodes) - 1) // 3)
+
     def _on_height(self, height: int) -> None:
+        # Disarm expired Byzantine windows first: their budget slots may
+        # be what lets a deferred event finally fire at this height.
+        still = []
+        for at, idx in self._disarm_at:
+            if at <= height:
+                self._disarm(idx)
+            else:
+                still.append((at, idx))
+        self._disarm_at = still
         while self._pending and self._pending[0].at_height <= height:
             ev = self._pending.pop(0)
+            ev = self._reserve(ev, height)
+            if ev is None:
+                continue  # deferred or dropped
             task = asyncio.get_running_loop().create_task(
                 self._fire(ev, height))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
 
+    # -- f-bound budget ----------------------------------------------------
+
+    def _reserve(self, ev: ChaosEvent, height: int
+                 ) -> Optional[ChaosEvent]:
+        """Claim a fault-budget slot (and resolve node=-1) synchronously
+        — _on_height fires events back-to-back, so the budget must be
+        taken before any task runs.  Returns the (possibly rewritten)
+        event to fire, or None after deferring/dropping it.
+
+        The f-bound is the ISSUE invariant: Byzantine windows never
+        overlap crashes past f = ⌊(n−1)/3⌋ total faulty nodes.  Pure
+        crash-crash overlap keeps the pre-Byzantine harness contract
+        (distinct targets on distinct heights; a long downtime may
+        still briefly overlap the next crash window) so legacy chaos
+        schedules replay with their original timing."""
+        if ev.kind not in ("crash", "byzantine"):
+            return ev
+        node = ev.node
+        armed = sum(1 for k in self._faulty.values() if k == "byzantine")
+        if ev.kind == "byzantine":
+            if node < 0:
+                node = self._pick_byzantine_target(height)
+            ok = (node is not None and node not in self._faulty
+                  and len(self._faulty) < self.f)
+        else:
+            # Crash: on its ORIGINAL height, constrained only by live
+            # adversary windows (the pre-Byzantine harness contract —
+            # the generator emits crashes on distinct heights, so
+            # legacy schedules replay with their original timing).  A
+            # DEFERRED crash may have collapsed onto another crash's
+            # height, so it must respect the full budget or n=4 loses
+            # quorum to two simultaneous crashes.
+            ok = (self._faulty.get(node) != "byzantine"
+                  and (len(self._faulty) < self.f
+                       or (ev.defers == 0 and armed == 0)))
+        if not ok:
+            if ev.defers + 1 > MAX_DEFERS:
+                logger.warning("chaos: dropping %s (f-bound never "
+                               "cleared after %d defers)", ev.kind,
+                               ev.defers)
+                self.dropped.append({"kind": ev.kind,
+                                     "at_height": ev.at_height,
+                                     "behavior": ev.behavior})
+                return None
+            deferred = dataclasses.replace(ev, at_height=height + 1,
+                                           defers=ev.defers + 1)
+            self._pending.append(deferred)
+            self._pending.sort(key=lambda e: e.at_height)
+            logger.info("chaos: deferring %s to height %d (f-bound)",
+                        ev.kind, height + 1)
+            return None
+        self._faulty[node] = ev.kind
+        return dataclasses.replace(ev, node=node)
+
+    def _pick_byzantine_target(self, height: int) -> Optional[int]:
+        """A non-faulty node that leads round 0 of an upcoming height —
+        two heights out gives the arm time to land before its turn, so
+        leader-dependent behaviors (equivocator, withholder) actually
+        run their play inside the window."""
+        by_addr = {n.name: i for i, n in enumerate(self.net.nodes)}
+        for ahead in range(2, 2 + len(self.net.nodes)):
+            try:
+                addr = self.net.nodes[0].engine.leader(height + ahead, 0)
+            except Exception:  # noqa: BLE001 — engine pre-run
+                return None
+            idx = by_addr.get(addr)
+            if idx is not None and idx not in self._faulty:
+                return idx
+        return None
+
+    def _disarm(self, idx: int) -> None:
+        try:
+            self.net.set_behavior(idx, None)
+        except Exception:  # noqa: BLE001 — node may have been rebuilt
+            logger.exception("chaos: disarm of node %d failed", idx)
+        if self._faulty.get(idx) == "byzantine":
+            del self._faulty[idx]
+
+    # -- event bodies ------------------------------------------------------
+
     async def _fire(self, ev: ChaosEvent, height: int) -> None:
         entry = {"kind": ev.kind, "at_height": ev.at_height,
                  "fired_height": height, "node": ev.node,
                  "duration_s": ev.duration_s}
+        if ev.kind == "byzantine":
+            entry["behavior"] = ev.behavior
+            entry["heights"] = ev.heights
         self.fired.append(entry)
-        logger.info("chaos: %s at height %d (node=%d, %.2fs)",
-                    ev.kind, height, ev.node, ev.duration_s)
+        logger.info("chaos: %s at height %d (node=%d, %.2fs%s)",
+                    ev.kind, height, ev.node, ev.duration_s,
+                    f", {ev.behavior}" if ev.behavior else "")
         try:
             if ev.kind == "crash":
                 await self._crash_restart(ev)
@@ -127,22 +333,38 @@ class ChaosRunner:
                 self.net.controller.inject_fault(ev.kind, ev.duration_s)
             elif ev.kind == "partition":
                 await self._partition_flip(ev)
+            elif ev.kind == "byzantine":
+                self._arm_byzantine(ev, height)
+            elif ev.kind == "device_fault":
+                self._inject_device_fault(ev)
             else:
                 logger.warning("chaos: unknown event kind %r", ev.kind)
         except Exception:  # noqa: BLE001 — chaos must not crash the run
             logger.exception("chaos event %s failed", ev.kind)
             entry["error"] = True
+            # Free the fault-budget slot ONLY for the kind that holds
+            # one here: crash releases itself in _crash_restart's
+            # finally, and the other kinds never reserved — popping
+            # unconditionally would release a slot some OTHER live
+            # fault still owns (f-bound breach).
+            if ev.kind == "byzantine":
+                self._faulty.pop(ev.node, None)
 
     async def _crash_restart(self, ev: ChaosEvent) -> None:
         node = self.net.nodes[ev.node]
         if node.recorder is not None:
             node.recorder.record("chaos_crash", node=ev.node)
-        self.net.crash_node(ev.node)
-        await asyncio.sleep(ev.duration_s)
-        revived = self.net.restart_node(ev.node)
-        if revived.recorder is not None:
-            revived.recorder.record("chaos_restart", node=ev.node,
-                                    init_height=revived.engine.height)
+        try:
+            self.net.crash_node(ev.node)
+            await asyncio.sleep(ev.duration_s)
+            revived = self.net.restart_node(ev.node)
+            if revived.recorder is not None:
+                revived.recorder.record("chaos_restart", node=ev.node,
+                                        init_height=revived.engine.height)
+        finally:
+            # Budget slot frees only once the node is back (or the
+            # restart failed and the exception path logged it).
+            self._faulty.pop(ev.node, None)
 
     async def _partition_flip(self, ev: ChaosEvent) -> None:
         """Isolate a minority (≤ f) group so the majority keeps
@@ -155,19 +377,109 @@ class ChaosRunner:
         await asyncio.sleep(ev.duration_s)
         self.net.router.set_partition()  # heal
 
+    def _arm_byzantine(self, ev: ChaosEvent, height: int) -> None:
+        self.net.set_behavior(ev.node, ev.behavior)
+        self._disarm_at.append((height + max(ev.heights, 1), ev.node))
+
+    def _inject_device_fault(self, ev: ChaosEvent) -> None:
+        node = self.net.nodes[ev.node]
+        breaker = getattr(node.crypto, "breaker", None)
+        if breaker is None or not hasattr(breaker, "inject_faults"):
+            logger.warning("chaos: node %d crypto has no breaker; "
+                           "device_fault skipped", ev.node)
+            return
+        # min_faults: the window must actually open the breaker even if
+        # the target spends most of it crashed/idle (seed 7 crashes the
+        # fault target mid-window) — the breaker keeps failing device
+        # calls past the wall-clock window until threshold faults landed.
+        breaker.inject_faults(
+            ev.duration_s,
+            min_faults=getattr(breaker, "failure_threshold", 0))
+        if node.recorder is not None:
+            node.recorder.record("chaos_device_fault", node=ev.node,
+                                 duration_s=ev.duration_s)
+        self._breakers.append((breaker, breaker.times_opened,
+                               breaker.total_injected))
+
+    # -- teardown ----------------------------------------------------------
+
     async def drain(self, timeout: float = 10.0) -> None:
-        """Wait for every fired event's follow-through (restarts, heals)
-        to finish.  Pending events whose heights were never reached are
-        dropped — the run decides how far the chain goes."""
+        """Wait for every fired event's follow-through (restarts, heals,
+        breaker recoveries) to finish and disarm any still-active
+        adversaries.  Pending events whose heights were never reached
+        are dropped — the run decides how far the chain goes."""
+        self._never_reached += len(self._pending)
         self._pending.clear()
+        # Await in-flight _fire tasks BEFORE the disarm sweep: a
+        # byzantine event queued on the final height would otherwise
+        # arm after the sweep and stay armed (leaking its budget slot)
+        # past the run.
         if self._tasks:
             await asyncio.wait_for(
                 asyncio.gather(*list(self._tasks), return_exceptions=True),
                 timeout)
+        for _, idx in self._disarm_at:
+            self._disarm(idx)
+        self._disarm_at.clear()
+        await self._settle_breakers(timeout)
+
+    async def _settle_breakers(self, timeout: float) -> None:
+        """Wait until every fault-injected breaker has run a genuine
+        open → half-open → closed cycle: opened at least once SINCE its
+        injection (times_opened past the baseline captured at inject
+        time — plain `state == closed` is vacuously true for a breaker
+        that never tripped), the fault window fully spent, and the
+        state closed again.  The fleet keeps committing during drain,
+        so device calls keep arriving to drive the cycle home.
+        Best-effort: a breaker that cannot settle by the deadline is
+        logged and its leftover fault window cleared (a crypto path
+        that makes no device calls — e.g. TpuBlsCrypto below its batch
+        threshold — would otherwise stay armed forever); the run's
+        metric assertions consult device_faults_effective to tell a
+        never-bit window from a genuinely stuck breaker."""
+        if not self._breakers:
+            return
+
+        def settled() -> bool:
+            return all(b.times_opened > opened0 and not b.fault_injected
+                       and b.state == "closed"
+                       for b, opened0, _ in self._breakers)
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            if settled():
+                return
+            await asyncio.sleep(0.05)
+        logger.warning("chaos: breaker(s) still %s after drain timeout",
+                       [(b.state, b.times_opened - opened0,
+                         b.total_injected - injected0)
+                        for b, opened0, injected0 in self._breakers])
+        for b, _, _ in self._breakers:
+            if b.fault_injected:
+                b.clear_injected_faults()
+
+    @property
+    def device_faults_effective(self) -> int:
+        """Fault-injected breakers whose window actually bit (at least
+        one device call failed on injection).  Zero on a fleet whose
+        crypto never dispatches to the device — e.g. TpuBlsCrypto under
+        its batch threshold — where no open→closed cycle can exist and
+        asserting one would fail a healthy run."""
+        return sum(1 for b, _, injected0 in self._breakers
+                   if b.total_injected > injected0)
 
     def summary(self) -> dict:
         return {
             "events_fired": len(self.fired),
-            "events_skipped": len(self._pending),
+            "events_skipped": (len(self._pending) + len(self.dropped)
+                               + self._never_reached),
             "events": self.fired,
+            "behaviors_active": sorted({e["behavior"]
+                                        for e in self.fired
+                                        if e["kind"] == "byzantine"}),
+            "device_faults_fired": sum(1 for e in self.fired
+                                       if e["kind"] == "device_fault"),
+            "device_faults_effective": self.device_faults_effective,
+            "f_bound": self.f,
         }

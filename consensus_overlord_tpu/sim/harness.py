@@ -73,12 +73,18 @@ class SimNode:
                  controller: SimController, wal: Optional[Wal] = None,
                  use_frontier: bool = False, frontier_max_batch: int = 1024,
                  frontier_linger_s: float = 0.002, metrics=None,
-                 recorder=None):
+                 recorder=None, node_seed: int = 0):
         from ..crypto.frontier import BatchingVerifier
+        from .adversary import AdversaryShim
 
         self.crypto = crypto
         self.wal = wal if wal is not None else MemoryWal(metrics=metrics)
         self.adapter = SimAdapter(crypto.pub_key, router, controller)
+        #: Every node carries the adversary shim — transparent until a
+        #: chaos `byzantine` event (or SimNetwork.set_behavior) arms a
+        #: behavior, so any validator can turn coat mid-run.
+        self.adversary = AdversaryShim(self.adapter, crypto, router,
+                                       seed=node_seed, recorder=recorder)
         self.frontier = (BatchingVerifier(crypto, frontier_max_batch,
                                           frontier_linger_s, metrics=metrics)
                          if use_frontier else None)
@@ -90,9 +96,10 @@ class SimNode:
         breaker = getattr(crypto, "breaker", None)
         if breaker is not None and recorder is not None:
             breaker.recorder = recorder
-        self.engine = Engine(crypto.pub_key, self.adapter, crypto, self.wal,
-                             frontier=self.frontier, metrics=metrics,
-                             recorder=recorder)
+        self.engine = Engine(crypto.pub_key, self.adversary, crypto,
+                             self.wal, frontier=self.frontier,
+                             metrics=metrics, recorder=recorder)
+        self.adversary.engine = self.engine  # leader_of follows its rotation
         self.router = router
         self._task: Optional[asyncio.Task] = None
         router.register(crypto.pub_key, self._on_network_msg)
@@ -151,14 +158,21 @@ class SimNetwork:
                  delay_range: tuple[float, float] = (0.0, 0.0),
                  crypto_factory=None, use_frontier: bool = False,
                  frontier_linger_s: float = 0.002, metrics=None,
-                 flight_recorder_capacity: int = 0, wal_factory=None):
+                 flight_recorder_capacity: int = 0, wal_factory=None,
+                 sim_device_crypto: bool = False,
+                 device_breaker_cooldown_s: float = 0.25):
         """metrics: one shared obs.Metrics for the whole fleet (histograms
         aggregate across nodes — fine for sim-level batch/round shape).
         flight_recorder_capacity > 0 gives every node its own event ring;
         dump_flight_recorders() renders them all for failure forensics.
         wal_factory(i) -> Wal gives node i a durable WAL (chaos runs pass
         a per-node FileWal so crash-restart exercises the disk recovery
-        path); None = per-node MemoryWal."""
+        path); None = per-node MemoryWal.
+        sim_device_crypto: wrap breaker-less providers in
+        SimDeviceCrypto (crypto/provider.py) so chaos `device_fault`
+        events have a circuit breaker + simulated device path to break
+        even in CPU-only fleets; providers that already carry a breaker
+        (TpuBlsCrypto) are left alone."""
         from ..obs.flightrec import FlightRecorder
 
         if crypto_factory is None:
@@ -173,6 +187,18 @@ class SimNetwork:
         self.router = Router(seed=seed, drop_rate=drop_rate,
                              delay_range=delay_range)
         cryptos = [crypto_factory(i) for i in range(n_validators)]
+        if sim_device_crypto:
+            from ..crypto.breaker import CircuitBreaker
+            from ..crypto.provider import SimDeviceCrypto
+
+            cryptos = [c if getattr(c, "breaker", None) is not None
+                       else SimDeviceCrypto(
+                           c, breaker=CircuitBreaker(
+                               failure_threshold=3,
+                               cooldown_s=device_breaker_cooldown_s,
+                               metrics=metrics),
+                           metrics=metrics)
+                       for c in cryptos]
         self.controller = SimController(
             [c.pub_key for c in cryptos], block_interval_ms)
         self.metrics = metrics
@@ -187,7 +213,8 @@ class SimNetwork:
                               metrics=metrics,
                               recorder=(FlightRecorder(
                                   flight_recorder_capacity)
-                                  if flight_recorder_capacity > 0 else None))
+                                  if flight_recorder_capacity > 0 else None),
+                              node_seed=seed ^ (0x9E3779B9 * (i + 1)))
                       for i, c in enumerate(cryptos)]
         self.controller.on_new_height.append(self._push_status)
 
@@ -216,6 +243,12 @@ class SimNetwork:
         network).  Its WAL survives — restart_node resumes from it."""
         self.nodes[i].crash()
 
+    def set_behavior(self, i: int, behavior: Optional[str]) -> None:
+        """Arm (or, with None, disarm) an adversary behavior on
+        validator i — sim/adversary.py names them; chaos `byzantine`
+        events toggle this on the height timeline."""
+        self.nodes[i].adversary.arm(behavior)
+
     def restart_node(self, i: int) -> SimNode:
         """Rebuild validator i from its WAL on the same keys/address —
         the crash-recovery path (WAL apply + controller-height init, the
@@ -230,7 +263,11 @@ class SimNetwork:
         node = SimNode(old.crypto, self.router, self.controller, wal=wal,
                        use_frontier=self._use_frontier,
                        frontier_linger_s=self._frontier_linger_s,
-                       metrics=self.metrics, recorder=old.recorder)
+                       metrics=self.metrics, recorder=old.recorder,
+                       node_seed=old.adversary.seed)
+        # Adversary tallies span the crash like the flight recorder does
+        # (run assertions read them after the schedule has played out).
+        node.adversary.behavior_stats = old.adversary.behavior_stats
         self.nodes[i] = node
         node.start(self.controller.latest_height + 1,
                    self.controller.block_interval_ms,

@@ -29,6 +29,11 @@ class Router:
         self._partitions: Optional[List[Set[Address]]] = None
         self.delivered = 0
         self.dropped = 0
+        #: Drop split: partition-cut vs random-loss (dropped = their sum)
+        self.dropped_partition = 0
+        self.dropped_loss = 0
+        #: Lifetime partition flips (set_partition calls with groups).
+        self.partition_flips = 0
 
     def register(self, address: Address, handler: Handler) -> None:
         """The reference's register_network_msg_handler equivalent
@@ -41,7 +46,35 @@ class Router:
     def set_partition(self, *groups: Set[Address]) -> None:
         """Partition the network into the given groups; nodes in different
         groups cannot reach each other.  Call with no args to heal."""
+        if groups:
+            self.partition_flips += 1
         self._partitions = [set(g) for g in groups] if groups else None
+
+    def peers(self) -> List[Address]:
+        """Currently registered addresses (adversary behaviors address
+        peers individually to equivocate/replay point-to-point)."""
+        return list(self._handlers)
+
+    @property
+    def partition_active(self) -> bool:
+        return self._partitions is not None
+
+    def stats(self) -> dict:
+        """Delivery/drop counters + live partition state for the sim
+        JSON summary and /statusz — adversarial message loss must be
+        attributable per run, not inferred from silence."""
+        return {
+            "delivered": self.delivered,
+            "dropped": self.dropped,
+            "dropped_partition": self.dropped_partition,
+            "dropped_loss": self.dropped_loss,
+            "partition_active": self.partition_active,
+            "partition_flips": self.partition_flips,
+            "partitions": ([sorted(a[:4].hex() for a in g)
+                            for g in self._partitions]
+                           if self._partitions is not None else []),
+            "registered": len(self._handlers),
+        }
 
     def _can_reach(self, a: Address, b: Address) -> bool:
         if self._partitions is None:
@@ -72,9 +105,11 @@ class Router:
             return
         if not self._can_reach(sender, target):
             self.dropped += 1
+            self.dropped_partition += 1
             return
         if self.drop_rate and self._rng.random() < self.drop_rate:
             self.dropped += 1
+            self.dropped_loss += 1
             return
         delay = 0.0
         if self.delay_range[1] > 0:

@@ -16,6 +16,75 @@ import sys
 import time
 
 
+def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
+    """Chaos acceptance beyond safety+liveness: every active adversary
+    behavior must have been CAUGHT (nonzero rejection counters for its
+    signature reasons; the withholder must have actually withheld
+    traffic — chokes alone can come from other chaos events), and an
+    injected device fault must have driven the breaker through a full
+    open -> half_open -> closed cycle.  Nothing to assert when the
+    schedule had no such events."""
+    from .adversary import REJECTION_REASONS
+
+    scraped = snapshot(metrics.registry)
+    summary = chaos.summary()
+    # With the batching frontier on, invalid-signature traffic (the
+    # forger's fabricated-identity votes) is dropped at the frontier
+    # before the engine's non_validator guard can see it.
+    frontier_on = any(n.frontier is not None for n in net.nodes)
+    for behavior in summary["behaviors_active"]:
+        reasons = REJECTION_REASONS[behavior]
+        if not reasons:  # withholder: silence, not forgeries
+            withheld = sum(
+                n.adversary.behavior_stats.get("adversary_withhold", 0)
+                for n in net.nodes)
+            assert withheld > 0, (
+                "withholder active but nothing was withheld")
+            continue
+        for reason in reasons:
+            if frontier_on and reason == "non_validator":
+                continue
+            count = scraped.get(
+                "consensus_byzantine_rejections_total"
+                f"{{reason={reason}}}", 0)
+            if behavior == "replayer" and count == 0:
+                # Replay detection races the randomized resend delays
+                # against height progression: a duplicate landing after
+                # the fleet moved on (or at a peer that never accepted
+                # the original) is dropped silently as an honest
+                # straggler.  The deterministic obligation is shim-side
+                # — duplicates actually left the adversary.
+                replayed = sum(
+                    n.adversary.behavior_stats.get("adversary_replay", 0)
+                    for n in net.nodes)
+                assert replayed > 0, (
+                    "replayer active but nothing was replayed")
+                print("warning: replayer duplicates all landed outside "
+                      "the detection window (timing); shim sent "
+                      f"{replayed} replay volleys", file=sys.stderr)
+                continue
+            assert count > 0, (
+                f"behavior {behavior} active but rejection counter "
+                f"{reason!r} stayed zero")
+    if summary["device_faults_fired"]:
+        if chaos.device_faults_effective == 0:
+            # The window never bit: this crypto path made no device
+            # calls at all (TpuBlsCrypto below its batch threshold
+            # early-outs to the host before raise_if_injected), so no
+            # open->closed cycle can exist and asserting one would fail
+            # a healthy run.  Say so loudly instead.
+            print("warning: device_fault window(s) armed but no device "
+                  "call ever hit them (sub-threshold device path?); "
+                  "breaker-cycle assertion skipped", file=sys.stderr)
+            return
+        for to in ("open", "half_open", "closed"):
+            count = scraped.get(
+                f"crypto_breaker_transitions_total{{to={to}}}", 0)
+            assert count > 0, (
+                f"device faults fired but no breaker transition to "
+                f"{to!r} recorded")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description="in-process consensus fleet")
     parser.add_argument("--validators", type=int, default=4)
@@ -36,10 +105,43 @@ def main() -> None:
     parser.add_argument("--chaos-crashes", type=int, default=2)
     parser.add_argument("--chaos-stalls", type=int, default=1)
     parser.add_argument("--chaos-partitions", type=int, default=1)
+    parser.add_argument("--chaos-byzantine", type=int, default=0,
+                        help="Byzantine adversary windows on the "
+                        "schedule: a live validator's outbound traffic "
+                        "is mutated by a behavior (sim/adversary.py) "
+                        "for a few heights, never exceeding "
+                        "f=(n-1)//3 faulty nodes concurrently with "
+                        "crashes.  Behaviors round-robin through "
+                        "equivocator/forger/replayer/withholder "
+                        "unless per-behavior counts are given; the "
+                        "run then ALSO asserts nonzero "
+                        "byzantine-rejection counters for each "
+                        "active behavior")
+    parser.add_argument("--chaos-equivocators", type=int, default=0)
+    parser.add_argument("--chaos-forgers", type=int, default=0)
+    parser.add_argument("--chaos-replayers", type=int, default=0)
+    parser.add_argument("--chaos-withholders", type=int, default=0)
+    parser.add_argument("--chaos-device-faults", type=int, default=0,
+                        help="device_fault events: the target node's "
+                        "crypto circuit breaker fails every device "
+                        "dispatch for the window, so breaker-open -> "
+                        "host-oracle fallback -> half-open recovery "
+                        "runs inside the schedule (breaker-less sim "
+                        "providers get a SimDeviceCrypto wrap); the "
+                        "run then also asserts a full "
+                        "open/half_open/closed transition cycle in "
+                        "metrics")
+    parser.add_argument("--chaos-byz-window", type=int, default=None,
+                        help="heights an adversary stays armed "
+                        "(default: max(2, --validators), so "
+                        "leader-dependent behaviors get their turn)")
     parser.add_argument("--chaos-downtime-ms", type=float, default=400.0,
                         help="crash-to-restart window per crash event")
     parser.add_argument("--chaos-window-ms", type=float, default=400.0,
                         help="controller-fault / partition window length")
+    parser.add_argument("--chaos-device-window-ms", type=float,
+                        default=600.0,
+                        help="device fault-injection window length")
     parser.add_argument("--crypto",
                         choices=["ed25519", "bls", "secp256k1", "sm2",
                                  "simhash"],
@@ -89,6 +191,22 @@ def main() -> None:
         format="%(asctime)s %(message)s")
 
     from . import SimNetwork
+
+    # Per-behavior counts override the round-robin --chaos-byzantine
+    # assignment; naming any behavior explicitly defines the full set.
+    # Validated up front — a usage error must not cost a TPU prewarm.
+    explicit_behaviors = (["equivocator"] * args.chaos_equivocators
+                          + ["forger"] * args.chaos_forgers
+                          + ["replayer"] * args.chaos_replayers
+                          + ["withholder"] * args.chaos_withholders)
+    # behaviors=None lets ChaosSchedule.generate apply its own
+    # round-robin default (single source of truth for activation order).
+    byz_behaviors = explicit_behaviors or None
+    n_byzantine = (len(explicit_behaviors) if explicit_behaviors
+                   else args.chaos_byzantine)
+    if (n_byzantine or args.chaos_device_faults) and not args.chaos:
+        parser.error("--chaos-byzantine / --chaos-device-faults need "
+                     "--chaos")
 
     if args.crypto == "bls":
         if args.tpu:
@@ -171,7 +289,8 @@ def main() -> None:
                          frontier_linger_s=args.frontier_linger_ms / 1000.0,
                          metrics=metrics,
                          flight_recorder_capacity=args.flightrec,
-                         wal_factory=wal_factory)
+                         wal_factory=wal_factory,
+                         sim_device_crypto=args.chaos_device_faults > 0)
         statusz_port = None
         if args.statusz_port is not None:
             # The fleet shares one registry; statusz reports node 0's
@@ -183,6 +302,9 @@ def main() -> None:
             metrics.add_status_source(
                 "flightrec", lambda: (net.nodes[0].recorder.tail(64)
                                       if net.nodes[0].recorder else []))
+            # Router delivery/drop counters + live partition state:
+            # adversarial message loss must be attributable per run.
+            metrics.add_status_source("router", net.router.stats)
             degraded = getattr(net.nodes[0].crypto, "degraded_status", None)
             if degraded is not None:
                 metrics.add_status_source("crypto", degraded)
@@ -200,23 +322,80 @@ def main() -> None:
                 args.heights, args.validators,
                 crashes=args.chaos_crashes, stalls=args.chaos_stalls,
                 partitions=args.chaos_partitions,
+                byzantine=n_byzantine,
+                device_faults=args.chaos_device_faults,
+                behaviors=byz_behaviors,
+                byz_window=args.chaos_byz_window,
                 downtime_s=args.chaos_downtime_ms / 1000.0,
-                window_s=args.chaos_window_ms / 1000.0)
+                window_s=args.chaos_window_ms / 1000.0,
+                device_window_s=args.chaos_device_window_ms / 1000.0)
             chaos = ChaosRunner(net, schedule)
             for ev in schedule.events:
+                detail = ""
+                if ev.kind == "crash":
+                    detail = f" (node {ev.node})"
+                elif ev.kind == "byzantine":
+                    detail = f" ({ev.behavior}, {ev.heights} heights)"
+                elif ev.kind == "device_fault":
+                    detail = f" (node {ev.node}, {ev.duration_s:.1f}s)"
                 print(f"chaos: {ev.kind} armed at height {ev.at_height}"
-                      + (f" (node {ev.node})" if ev.kind == "crash" else ""))
+                      + detail)
         t0 = time.perf_counter()
         last = t0
         height_ms = []
+
+        async def advance(h: int, label: str = "") -> None:
+            """One height of progress; a miss is a liveness failure —
+            as load-bearing a red flag as a SafetyViolation — so dump
+            every flight recorder (the wedged, possibly adversarial,
+            run must be diagnosable) and exit non-zero."""
+            try:
+                await net.run_until_height(h, timeout=args.timeout)
+            except asyncio.TimeoutError:
+                print(f"LIVENESS FAILURE: stuck at height "
+                      f"{net.controller.latest_height}, wanted {h}"
+                      f"{label} within {args.timeout}s", file=sys.stderr)
+                if args.flightrec:
+                    print(net.dump_flight_recorders(64), file=sys.stderr)
+                if chaos is not None:
+                    print(f"chaos summary: {json.dumps(chaos.summary())}",
+                          file=sys.stderr)
+                print(f"router: {json.dumps(net.router.stats())}",
+                      file=sys.stderr)
+                # Tear the fleet down before exiting: N live engine
+                # tasks dying with the loop would spray task-destroyed
+                # warnings over the forensic dump above.
+                try:
+                    await net.stop()
+                except Exception:  # noqa: BLE001 — exiting anyway
+                    pass
+                raise SystemExit(2)
+
         try:
             for h in range(1, args.heights + 1):
-                await net.run_until_height(h, timeout=args.timeout)
+                await advance(h, f" (of {args.heights})")
                 now = time.perf_counter()
                 height_ms.append((now - last) * 1000)
                 print(f"height {h} committed (+{height_ms[-1]:.1f} ms)")
                 last = now
+            # total_s / ms_per_height measure the TARGET heights only —
+            # the schedule runway below commits extra heights and must
+            # not skew timings compared across seeds/PRs (it gets its
+            # own runway_s field instead).
+            t_target = time.perf_counter()
             if chaos is not None:
+                # Runway: a dense schedule (or f-bound deferrals) can
+                # leave events unfired at the target height — keep
+                # committing until the whole schedule has played out
+                # (every event fired, every adversary window closed),
+                # bounded so a starved event can't run us forever.
+                runway_cap = net.controller.latest_height + \
+                    4 * len(schedule.events) + 8
+                while ((chaos.pending_count or chaos.byzantine_armed
+                        or chaos.inflight_count)
+                       and net.controller.latest_height < runway_cap):
+                    await advance(net.controller.latest_height + 1,
+                                  " (schedule runway)")
                 await chaos.drain()
                 # The run's whole point: every injected fault recovered,
                 # the chain reached its target, and no two nodes ever
@@ -224,6 +403,7 @@ def main() -> None:
                 assert not net.controller.violations, (
                     f"safety violations: {net.controller.violations}")
                 assert net.controller.latest_height >= args.heights
+                _assert_adversarial(metrics, chaos, snapshot, net)
         except Exception:
             if args.flightrec:
                 print(net.dump_flight_recorders(64), file=sys.stderr)
@@ -231,7 +411,11 @@ def main() -> None:
         finally:
             if statusz_port is not None:
                 metrics.stop_exporter()
-        total = time.perf_counter() - t0
+        total = t_target - t0
+        runway_s = time.perf_counter() - t_target
+        # stop() unregisters every node — snapshot the router while the
+        # fleet is still live so registered/partition state is truthful.
+        router_stats = net.router.stats()
         await net.stop()
         if wal_tmp is not None:
             wal_tmp.cleanup()
@@ -264,11 +448,13 @@ def main() -> None:
             "crypto": args.crypto,
             "tpu": args.tpu,
             "total_s": round(total, 3),
+            "runway_s": round(runway_s, 3),
             "ms_per_height": round(total * 1000 / args.heights, 1),
             "p50_ms": pct(0.50),
             "p95_ms": pct(0.95),
-            "delivered": net.router.delivered,
-            "dropped": net.router.dropped,
+            "delivered": router_stats["delivered"],
+            "dropped": router_stats["dropped"],
+            "router": router_stats,
             **frontier,
             "metrics": obs,
         }
@@ -279,6 +465,16 @@ def main() -> None:
                 "safety_violations": len(net.controller.violations),
                 **chaos.summary(),
             }
+            rejections = {
+                k.split("reason=", 1)[1].rstrip("}"): v
+                for k, v in scraped.items()
+                if k.startswith("consensus_byzantine_rejections_total{")}
+            if rejections or n_byzantine:
+                out["byzantine"] = {
+                    "behaviors_active":
+                        out["chaos"]["behaviors_active"],
+                    "rejections": rejections,
+                }
         return out
 
     print(json.dumps(asyncio.run(run())))
