@@ -29,6 +29,7 @@ from typing import List, Optional, Tuple
 
 from ..core.sm3 import sm3_hash
 from ..core.types import SignedChoke, SignedProposal, SignedVote
+from ..obs.prof import annotate
 
 logger = logging.getLogger("consensus_overlord_tpu.frontier")
 
@@ -99,7 +100,7 @@ class BatchingVerifier:
                               fut, msg_type, time.perf_counter()))
         self.stats.requests += 1
         if len(self._pending) >= self._max_batch:
-            self._flush_now()
+            self._flush_now("max_batch")
         elif self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.get_running_loop().create_task(
                 self._linger_then_flush())
@@ -152,17 +153,57 @@ class BatchingVerifier:
         return await asyncio.to_thread(resolver)
 
     def close(self) -> None:
-        """Release the dispatch worker thread (engine/sim teardown)."""
-        self._dispatcher.shutdown(wait=False)
+        """Release the dispatch worker thread (engine/sim teardown).
+        Still-pending requests are flushed first (reason="shutdown") so
+        their futures resolve instead of hanging their awaiters — only
+        possible from a running event loop (the normal teardown path).
+        The worker shuts down only after in-flight batch tasks (incl. a
+        shutdown flush) have dispatched through it — shutting it down
+        eagerly would bounce those batches onto the per-signature host
+        re-verify fallback (RuntimeError from run_in_executor)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:  # no loop: nothing can await those futures
+            loop = None
+            self._pending = []
+        if self._pending:
+            self._flush_now("shutdown")
+        if loop is not None and self._inflight:
+            dispatcher = self._dispatcher
+
+            async def _drain_then_release(tasks):
+                try:
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                finally:
+                    # Loop teardown can cancel this task mid-gather; the
+                    # worker thread must be released regardless or each
+                    # closed frontier leaks one non-daemon thread.
+                    dispatcher.shutdown(wait=False)
+
+            # Pinned in _inflight: asyncio holds only weak task refs
+            # (see __init__) — an unpinned drain task can be GC'd
+            # mid-await, leaking the worker thread.
+            task = loop.create_task(_drain_then_release(
+                list(self._inflight)))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+        else:
+            self._dispatcher.shutdown(wait=False)
 
     async def _linger_then_flush(self) -> None:
         await asyncio.sleep(self._linger)
-        self._flush_now()
+        self._flush_now("linger")
 
-    def _flush_now(self) -> None:
+    def _flush_now(self, reason: str) -> None:
         batch, self._pending = self._pending, []
         if not batch:
             return
+        if self._metrics is not None:
+            # Why the batch left the frontier: linger-expired vs
+            # max-batch vs shutdown drain — without this the queue-wait
+            # histogram is uninterpretable (a long wait is EXPECTED
+            # under linger flushes, a red flag under max-batch ones).
+            self._metrics.frontier_flush_reason.labels(reason=reason).inc()
         if self._flush_task is not None and not self._flush_task.done():
             self._flush_task.cancel()
         self._flush_task = None
@@ -193,8 +234,10 @@ class BatchingVerifier:
                 # with device compute.
                 loop = asyncio.get_running_loop()
                 t0 = time.perf_counter()
-                resolver = await loop.run_in_executor(
-                    self._dispatcher, verify_async, sigs, hashes, voters)
+                with annotate("frontier.flush"):
+                    resolver = await loop.run_in_executor(
+                        self._dispatcher, verify_async, sigs, hashes,
+                        voters)
                 t1 = time.perf_counter()
                 results = await asyncio.to_thread(resolver)
                 if m is not None:

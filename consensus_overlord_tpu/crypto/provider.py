@@ -16,6 +16,7 @@ config 2's curve), and the TPU-batched providers live in crypto/tpu_*.
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import List, Optional, Protocol, Sequence, runtime_checkable
 
 from ..core.sm3 import sm3_hash
@@ -288,10 +289,19 @@ class SimDeviceCrypto:
         self.breaker = breaker if breaker is not None else CircuitBreaker(
             failure_threshold=3, cooldown_s=0.25)
         self.metrics = metrics
+        #: Optional obs.prof.DeviceProfiler: the simulated device path
+        #: records the same staged per-call profiles as TpuBlsCrypto
+        #: (here the whole 'device' round-trip is one host call, so the
+        #: dispatch stage carries it and occupancy is always 1.0) — CPU
+        #: fleets exercise the full profile surface with zero hardware.
+        self.prof = None
 
     def bind_metrics(self, metrics) -> None:
         self.metrics = metrics
         self.breaker.metrics = metrics
+
+    def bind_profiler(self, prof) -> None:
+        self.prof = prof
 
     def degraded_status(self) -> dict:
         """Breaker + fallback state for /statusz ("crypto" section)."""
@@ -307,11 +317,13 @@ class SimDeviceCrypto:
     def sign(self, hash32: bytes) -> bytes:
         return self._base.sign(hash32)
 
-    def _device_call(self, path: str, fn, *args):
+    def _device_call(self, path: str, fn, *args, batch: int = 1):
         """The TpuBlsCrypto dispatch posture in miniature: ask the
         breaker, 'dispatch' (fault-injection window = the device
         failing), report the outcome, fall back to the host oracle —
-        which here is the same function, so results are always exact."""
+        which here is the same function, so results are always exact.
+        A bound profiler sees the same staged-profile surface as the
+        real device path (dispatch = the simulated device call)."""
         if not self.breaker.allow():
             if self.metrics is not None:
                 self.metrics.host_fallbacks.labels(path=path).inc()
@@ -323,8 +335,28 @@ class SimDeviceCrypto:
             if self.metrics is not None:
                 self.metrics.device_failures.labels(path=path).inc()
                 self.metrics.host_fallbacks.labels(path=path).inc()
+            if self.prof is not None:
+                # The failed device call rings ok=False (no stages ran
+                # — the fault hit before dispatch), mirroring the real
+                # provider's posture, so chaos post-mortems see the
+                # degraded window in the profile ring too.
+                self.prof.begin(path, batch).finish(ok=False)
             return fn(*args)
-        result = fn(*args)
+        if self.prof is None:
+            result = fn(*args)
+            self.breaker.record_success()
+            return result
+        call = self.prof.begin(path, batch)
+        call.pad(batch, batch)  # no pad ladder: the sim batch ships as-is
+        t0 = time.perf_counter()
+        try:
+            result = fn(*args)
+        except BaseException:  # a raising call must not ring as ok
+            call.observe("dispatch", time.perf_counter() - t0)
+            call.finish(ok=False)
+            raise
+        call.observe("dispatch", time.perf_counter() - t0)
+        call.finish()
         self.breaker.record_success()
         return result
 
@@ -336,16 +368,18 @@ class SimDeviceCrypto:
     def aggregate_signatures(self, signatures: Sequence[bytes],
                              voters: Sequence[bytes]) -> bytes:
         return self._device_call("aggregate", self._base.aggregate_signatures,
-                                 signatures, voters)
+                                 signatures, voters, batch=len(signatures))
 
     def verify_aggregated_signature(self, agg_sig: bytes, hash32: bytes,
                                     voters: Sequence[bytes]) -> bool:
         return self._device_call("verify_aggregated",
                                  self._base.verify_aggregated_signature,
-                                 agg_sig, hash32, voters)
+                                 agg_sig, hash32, voters,
+                                 batch=len(voters))
 
     def verify_batch(self, signatures: Sequence[bytes],
                      hashes: Sequence[bytes],
                      voters: Sequence[bytes]) -> List[bool]:
         return self._device_call("verify_batch", self._base.verify_batch,
-                                 signatures, hashes, voters)
+                                 signatures, hashes, voters,
+                                 batch=len(signatures))

@@ -52,6 +52,7 @@ import numpy as np
 
 from ..compile_cache import enable as _enable_compile_cache
 from ..core.sm3 import sm3_hash
+from ..obs.prof import NULL_CALL, annotate
 from .breaker import CircuitBreaker
 
 # The provider's kernels are the big compiles; make sure every process
@@ -323,6 +324,14 @@ class TpuBlsCrypto:
         #: path (prep / readback / pairing) land in crypto_dispatch_ms.
         #: None (the default) keeps the measured bench path untouched.
         self.metrics = None
+        #: Optional obs.prof.DeviceProfiler: staged per-call round
+        #: profiles (parse/dispatch/readback/pairing into
+        #: crypto_device_stage_seconds{stage,op} + the profile ring) and
+        #: mesh-path gauges.  None = pre-profiling path.
+        self.prof = None
+        #: Cached collective-free twin of the mesh verify kernel
+        #: (profile_sharded_stages probe) — built on first probe.
+        self._stage_probe = None
         #: Device circuit breaker: consulted before every device
         #: dispatch, reported to after every resolve.  An open breaker
         #: means this provider is in degraded mode — exact results from
@@ -336,6 +345,28 @@ class TpuBlsCrypto:
         pays one attribute check."""
         self.metrics = metrics
         self.breaker.metrics = metrics
+
+    def bind_profiler(self, prof) -> None:
+        """Attach a device profiler (obs.prof.DeviceProfiler): every
+        device op then records a staged per-call profile, and the mesh
+        gauges (mesh_devices / device_kind) describe this provider's
+        dispatch target."""
+        self.prof = prof
+        if prof is None:
+            return
+        mesh = getattr(self._kernels, "mesh", None)
+        try:
+            devices = (list(mesh.devices.flat) if mesh is not None
+                       else jax.devices()[:1])
+            prof.set_devices(devices)
+        except Exception:  # noqa: BLE001 — profiling never breaks crypto
+            pass
+
+    def _prof_begin(self, op: str, n: int):
+        """A StagedCall for one device op (the no-op twin when no
+        profiler is bound, so call sites stay branch-free)."""
+        return self.prof.begin(op, n) if self.prof is not None \
+            else NULL_CALL
 
     def degraded_status(self) -> dict:
         """Breaker + fallback state for /statusz ("crypto" section)."""
@@ -359,14 +390,51 @@ class TpuBlsCrypto:
             self.metrics.device_failures.labels(path=path).inc()
             self.metrics.host_fallbacks.labels(path=path).inc()
 
-    def _observe_phase(self, phase: str, t0: float) -> float:
-        """Observe one host-side device-path phase; returns a fresh
-        timestamp so call sites can chain phases."""
+    #: crypto_dispatch_ms phase → crypto_device_stage_seconds stage (the
+    #: stage family keeps profile_verify.py's names; "prep" has always
+    #: been the parse/pad/RLC stage).
+    _STAGE_OF = {"prep": "parse"}
+
+    def _observe_phase(self, phase: str, t0: float, call=NULL_CALL) -> float:
+        """Observe one host-side device-path phase (ms histogram + the
+        staged call's stage record); returns a fresh timestamp so call
+        sites can chain phases."""
         now = time.perf_counter()
         if self.metrics is not None:
             self.metrics.crypto_dispatch_ms.labels(phase=phase).observe(
                 (now - t0) * 1000.0)
+        call.observe(self._STAGE_OF.get(phase, phase), now - t0)
         return now
+
+    def _shard_latencies(self, sharded_out, sampled: bool = False) -> None:
+        """Per-device fetch timing on a sharded output (the validity
+        mask, sharded P(lanes)) AFTER the result is complete: with
+        compute already drained, each shard's blocking fetch measures
+        that device's D2H path alone, so a straggling or degraded chip
+        is the outlier gauge.  Each fetch is still its own serialized
+        D2H read (~150 ms over a remote PJRT link), so hot-path callers
+        are THROTTLED through the profiler's sample interval — and run
+        after the readback stage is observed, never inside it; only the
+        explicit probe (profile_sharded_stages) passes sampled=True to
+        bypass the throttle."""
+        if self.prof is None:
+            return
+        if not sampled:
+            # Hot-path caller: only meaningful (and only throttled)
+            # when the provider's own kernels run on a mesh.
+            if getattr(self._kernels, "mesh", None) is None:
+                return
+            if not self.prof.want_device_sample():
+                return
+        try:
+            for shard in sharded_out.addressable_shards:
+                t0 = time.perf_counter()
+                np.asarray(shard.data)
+                self.prof.device_latency(
+                    f"{shard.device.platform}:{shard.device.id}",
+                    time.perf_counter() - t0)
+        except Exception:  # noqa: BLE001 — profiling never breaks crypto
+            pass
 
     def _pad_to(self, n: int) -> int:
         """Pad ladder size, kept a multiple of the mesh lane count so
@@ -409,9 +477,12 @@ class TpuBlsCrypto:
                 or not self._device_allowed("aggregate")):
             return lambda: self._cpu.aggregate_signatures(signatures, voters)
         n = len(signatures)
+        call = self._prof_begin("aggregate", n)
         try:
             self.breaker.raise_if_injected("aggregate")
+            t0 = time.perf_counter()
             size = self._pad_to(n)
+            call.pad(n, size)
             parsed = dev.parse_g1_compressed(list(signatures))
             x = np.zeros((size, dev.FQ.n), np.int32)
             x[:n] = parsed.x
@@ -421,25 +492,35 @@ class TpuBlsCrypto:
             inf[:n] = parsed.infinity
             ok = np.zeros(size, bool)
             ok[:n] = parsed.wellformed
-            out = self._kernels.g1_validate_sum(
-                jnp.asarray(x), jnp.asarray(sign_f), jnp.asarray(inf),
-                jnp.asarray(ok))
+            call.observe("parse", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            with annotate("tpu_bls.aggregate.dispatch"):
+                out = self._kernels.g1_validate_sum(
+                    jnp.asarray(x), jnp.asarray(sign_f), jnp.asarray(inf),
+                    jnp.asarray(ok))
+            call.observe("dispatch", time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 — device dispatch failed
             self._device_failed("aggregate", e)
+            call.finish(ok=False)
             return lambda: self._cpu.aggregate_signatures(signatures, voters)
 
         def resolve() -> bytes:
             # ONE device_get for the whole output tuple: each separate
             # np.asarray()/bool() on a device array is its own blocking
             # D2H round-trip (~150 ms on a remote PJRT link).
+            t0 = time.perf_counter()
             try:
                 ax, ay, ainf, valid = jax.device_get(out)
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("aggregate", e)
+                call.finish(ok=False)
                 return self._cpu.aggregate_signatures(signatures, voters)
             self.breaker.record_success()
+            call.observe("readback", time.perf_counter() - t0)
             if not bool(valid[:n].all()):
+                call.finish(ok=False)  # the call raised — never ring ok
                 raise CryptoError("invalid signature in aggregation batch")
+            call.finish()
             return oracle.g1_compress(_affine_to_oracle_g1(ax, ay, ainf))
 
         return resolve
@@ -457,46 +538,71 @@ class TpuBlsCrypto:
                 or not self._device_allowed("verify_aggregated")):
             return lambda: self._cpu.verify_aggregated_signature(
                 agg_sig, hash32, voters)
+        call = self._prof_begin("verify_aggregated", len(voters))
         try:
             self.breaker.raise_if_injected("verify_aggregated")
+            t0 = time.perf_counter()
             idx = self._pk_rows_of(voters)
             if (idx < 0).any():
-                # An aggregated QC over an invalid key can never verify.
+                # An aggregated QC over an invalid key can never verify
+                # (no device dispatch happened: an ok=False record with
+                # only the parse stage marks the early rejection).
+                call.observe("parse", time.perf_counter() - t0)
+                call.finish(ok=False)
                 return lambda: False
             n = len(voters)
             size = self._pad_to(n)
+            call.pad(n, size)
             rows = np.zeros(size, np.int64)
             rows[:n] = idx
             mask = np.zeros(size, bool)
             mask[:n] = True
+            call.observe("parse", time.perf_counter() - t0)
+            t0 = time.perf_counter()
             pkx, pky, pkz = self._pk_device()
-            out = self._kernels.g2_sum_rows(
-                jnp.asarray(rows), jnp.asarray(mask), pkx, pky, pkz)
+            with annotate("tpu_bls.verify_aggregated.dispatch"):
+                out = self._kernels.g2_sum_rows(
+                    jnp.asarray(rows), jnp.asarray(mask), pkx, pky, pkz)
+            call.observe("dispatch", time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 — device dispatch failed
             self._device_failed("verify_aggregated", e)
+            call.finish(ok=False)
             return lambda: self._cpu.verify_aggregated_signature(
                 agg_sig, hash32, voters)
 
         def resolve() -> bool:
+            t0 = time.perf_counter()
             try:
                 agg_pk = _affine_to_oracle_g2(*jax.device_get(out))
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_aggregated", e)
+                call.finish(ok=False)
                 return self._cpu.verify_aggregated_signature(
                     agg_sig, hash32, voters)
             self.breaker.record_success()
-            if agg_pk is None:
-                return False
+            call.observe("readback", time.perf_counter() - t0)
+            t0 = time.perf_counter()
             try:
-                sig_pt = oracle.g1_decompress(agg_sig)
-            except ValueError:
-                return False
-            if sig_pt is None or not oracle.g1_in_subgroup(sig_pt):
-                return False
-            h = oracle.hash_to_g1(hash32, self._common_ref)
-            neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
-            return oracle.multi_pairing_is_one([(sig_pt, neg_g2),
-                                                (h, agg_pk)])
+                if agg_pk is None:
+                    return False
+                try:
+                    sig_pt = oracle.g1_decompress(agg_sig)
+                except ValueError:
+                    return False
+                if sig_pt is None or not oracle.g1_in_subgroup(sig_pt):
+                    return False
+                h = oracle.hash_to_g1(hash32, self._common_ref)
+                neg_g2 = (oracle.G2_GEN[0],
+                          oracle.fq2_neg(oracle.G2_GEN[1]))
+                result = oracle.multi_pairing_is_one([(sig_pt, neg_g2),
+                                                      (h, agg_pk)])
+                # Observed only when the pairing actually ran: garbage
+                # QCs returning early above must not flood the stage
+                # with near-zero samples and collapse its percentiles.
+                call.observe("pairing", time.perf_counter() - t0)
+                return result
+            finally:
+                call.finish()
 
         return resolve
 
@@ -543,19 +649,27 @@ class TpuBlsCrypto:
         for i, h in enumerate(hashes):
             groups.setdefault(bytes(h), []).append(i)
 
+        # Created before any failure point (incl. the injected-fault
+        # raise) so the except below finishes the real record — every
+        # failed device attempt lands in the ring as ok=False.  The
+        # >ladder split below never rings it (each sub-batch profiles
+        # itself); an empty unfinished call has no side effects.
+        call = self._prof_begin("verify_batch", n)
         try:
             self.breaker.raise_if_injected("verify_batch")
             if len(groups) == 1:
                 t0 = time.perf_counter()
-                prep = self._host_prep(signatures, voters, n)
-                self._observe_phase("prep", t0)
+                prep = self._host_prep(signatures, voters, n, call=call)
+                self._observe_phase("prep", t0, call)
                 return self._dispatch_single_hash(
-                    signatures, bytes(hashes[0]), voters, n, *prep)
+                    signatures, bytes(hashes[0]), voters, n, *prep,
+                    call=call)
             if len(groups) <= _GROUP_SIZES[-1]:
                 return self._dispatch_multi_hash(signatures, voters, n,
-                                                 groups)
+                                                 groups, call=call)
         except Exception as e:  # noqa: BLE001 — device dispatch failed
             self._device_failed("verify_batch", e)
+            call.finish(ok=False)
             return lambda: [self._cpu.verify_signature(s, h, v)
                             for s, h, v in zip(signatures, hashes, voters)]
         # Many distinct hashes (beyond the fused-kernel ladder): verify
@@ -578,7 +692,7 @@ class TpuBlsCrypto:
 
     # -- internals -----------------------------------------------------------
 
-    def _host_prep(self, signatures, voters, n):
+    def _host_prep(self, signatures, voters, n, call=NULL_CALL):
         """Shared host-side prep for every batch path (one copy: all
         paths must verify under identical parsing, padding, and RLC
         weight distributions or they drift apart): parse + pad signature
@@ -588,6 +702,7 @@ class TpuBlsCrypto:
         pk_idx = self._pk_rows_of(voters)
         pk_ok = pk_idx >= 0
         size = self._pad_to(n)
+        call.pad(n, size)
         if self.metrics is not None:
             # Padded-rung occupancy, observed where the pad is computed:
             # every device batch — fused single/multi-hash AND each
@@ -621,15 +736,16 @@ class TpuBlsCrypto:
 
     def _dispatch_single_hash(self, signatures, h, voters, n, size,
                               sx, ssign, sinf, sok, wpacked, rows,
-                              pk_idx, pk_ok):
+                              pk_idx, pk_ok, call=NULL_CALL):
         """Dispatch the fused kernel; return resolve() → List[bool]."""
         t0 = time.perf_counter()
         pkx, pky, pkz = self._pk_device()
-        out = self._kernels.verify_round(
-            jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-            jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
-            pkx, pky, pkz)
-        self._observe_phase("dispatch", t0)
+        with annotate("tpu_bls.verify_round.dispatch"):
+            out = self._kernels.verify_round(
+                jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+                jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
+                pkx, pky, pkz)
+        self._observe_phase("dispatch", t0, call)
 
         def resolve() -> List[bool]:
             # ONE device_get: separate per-output reads would each pay a
@@ -640,50 +756,62 @@ class TpuBlsCrypto:
                 ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_batch", e)
+                call.finish(ok=False)
                 return [self._cpu.verify_signature(signatures[i], h,
                                                    voters[i])
                         for i in range(n)]
             self.breaker.record_success()
-            t0 = self._observe_phase("readback", t0)
-            v = valid[:n] & pk_ok
-            if not v.any():
-                return [False] * n
-            agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
-            agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
-            h_pt = oracle.hash_to_g1(h, self._common_ref)
-            neg_g2 = (oracle.G2_GEN[0],
-                      oracle.fq2_neg(oracle.G2_GEN[1]))
-            paired = oracle.multi_pairing_is_one([(agg_sig, neg_g2),
-                                                  (h_pt, agg_pk)])
-            self._observe_phase("pairing", t0)
-            if paired:
-                return list(v)
-            # Batch relation failed: exact per-lane localization.
-            return [bool(v[i]) and self._verify_one_cached(
-                        signatures[i], h, voters[i])
-                    for i in range(n)]
+            self._observe_phase("readback", t0, call)
+            # Per-chip skew sample AFTER the readback stage is observed
+            # (compute drained): its extra D2H reads must never inflate
+            # or hollow out ANY stage histogram (throttled) — t0 is
+            # re-taken below so the pairing stage excludes it too.
+            self._shard_latencies(out[3])
+            t0 = time.perf_counter()
+            try:
+                v = valid[:n] & pk_ok
+                if not v.any():
+                    return [False] * n
+                agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
+                agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
+                h_pt = oracle.hash_to_g1(h, self._common_ref)
+                neg_g2 = (oracle.G2_GEN[0],
+                          oracle.fq2_neg(oracle.G2_GEN[1]))
+                paired = oracle.multi_pairing_is_one([(agg_sig, neg_g2),
+                                                      (h_pt, agg_pk)])
+                self._observe_phase("pairing", t0, call)
+                if paired:
+                    return list(v)
+                # Batch relation failed: exact per-lane localization.
+                return [bool(v[i]) and self._verify_one_cached(
+                            signatures[i], h, voters[i])
+                        for i in range(n)]
+            finally:
+                call.finish()
 
         return resolve
 
     def _dispatch_multi_hash(self, signatures, voters, n,
-                             groups: Dict[bytes, List[int]]):
+                             groups: Dict[bytes, List[int]],
+                             call=NULL_CALL):
         """Dispatch the k-group fused kernel (k padded up the group-count
         ladder with empty masks); return resolve() → List[bool]."""
         t0 = time.perf_counter()
         (size, sx, ssign, sinf, sok, wpacked, rows,
-         pk_idx, pk_ok) = self._host_prep(signatures, voters, n)
+         pk_idx, pk_ok) = self._host_prep(signatures, voters, n, call=call)
         k = next(s for s in _GROUP_SIZES if len(groups) <= s)
         gmask = np.zeros((k, size), bool)
         ghashes = list(groups)
         for g, h in enumerate(ghashes):
             gmask[g, groups[h]] = True
-        t0 = self._observe_phase("prep", t0)
+        t0 = self._observe_phase("prep", t0, call)
         pkx, pky, pkz = self._pk_device()
-        out = self._kernels.verify_round_multi(
-            jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-            jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
-            jnp.asarray(gmask), pkx, pky, pkz)
-        self._observe_phase("dispatch", t0)
+        with annotate("tpu_bls.verify_round_multi.dispatch"):
+            out = self._kernels.verify_round_multi(
+                jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+                jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
+                jnp.asarray(gmask), pkx, pky, pkz)
+        self._observe_phase("dispatch", t0, call)
         lane_hashes = self._lane_hashes(groups, n)
 
         def resolve() -> List[bool]:
@@ -692,36 +820,117 @@ class TpuBlsCrypto:
                 flat = jax.device_get(out)
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_batch", e)
+                call.finish(ok=False)
                 return [self._cpu.verify_signature(signatures[i],
                                                    lane_hashes[i], voters[i])
                         for i in range(n)]
             self.breaker.record_success()
-            t0 = self._observe_phase("readback", t0)
-            ax, ay, ainf, valid = flat[:4]
-            v = valid[:n] & pk_ok
-            if not v.any():
-                return [False] * n
-            agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
-            neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
-            pairs = [(agg_sig, neg_g2)]
-            for g, h in enumerate(ghashes):
-                gx, gy, ginf = flat[4 + 3 * g: 7 + 3 * g]
-                agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
-                if agg_pk is None:
-                    # No valid lane voted on this hash — nothing to pair.
-                    continue
-                pairs.append((oracle.hash_to_g1(h, self._common_ref),
-                              agg_pk))
-            paired = oracle.multi_pairing_is_one(pairs)
-            self._observe_phase("pairing", t0)
-            if paired:
-                return list(v)
-            # Batch relation failed: exact per-lane localization.
-            return [bool(v[i]) and self._verify_one_cached(
-                        signatures[i], lane_hashes[i], voters[i])
-                    for i in range(n)]
+            self._observe_phase("readback", t0, call)
+            self._shard_latencies(out[3])  # post-readback skew sample
+            t0 = time.perf_counter()  # pairing excludes the sample's D2H
+            try:
+                ax, ay, ainf, valid = flat[:4]
+                v = valid[:n] & pk_ok
+                if not v.any():
+                    return [False] * n
+                agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
+                neg_g2 = (oracle.G2_GEN[0],
+                          oracle.fq2_neg(oracle.G2_GEN[1]))
+                pairs = [(agg_sig, neg_g2)]
+                for g, h in enumerate(ghashes):
+                    gx, gy, ginf = flat[4 + 3 * g: 7 + 3 * g]
+                    agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
+                    if agg_pk is None:
+                        # No valid lane voted on this hash — nothing to
+                        # pair.
+                        continue
+                    pairs.append((oracle.hash_to_g1(h, self._common_ref),
+                                  agg_pk))
+                paired = oracle.multi_pairing_is_one(pairs)
+                self._observe_phase("pairing", t0, call)
+                if paired:
+                    return list(v)
+                # Batch relation failed: exact per-lane localization.
+                return [bool(v[i]) and self._verify_one_cached(
+                            signatures[i], lane_hashes[i], voters[i])
+                        for i in range(n)]
+            finally:
+                call.finish()
 
         return resolve
+
+    def profile_sharded_stages(self, signatures, voters,
+                               warm: bool = True) -> dict:
+        """Sampled mesh probe: split the fused verify round into its
+        per-device local stage vs its cross-device combine stage, which
+        one fused program cannot expose.  Times (block_until_ready) the
+        collective-free twin (sharded_verify_round_local: validate +
+        partial MSMs, outputs sharded) and the full kernel; the
+        difference is the all-gather over ICI + the replicated log2(D)
+        finish.  Observes sharded_partial_reduce_seconds /
+        sharded_allgather_seconds and per-device shard-fetch latency
+        through the bound profiler; returns the timings.
+
+        COSTS real dispatches (plus a one-time compile of the twin on
+        `warm`), so it runs where sampling is explicit —
+        scripts/profile_verify.py and ProfileSession captures — never
+        on the per-batch hot path.  Works on a 1-device mesh too (the
+        combine stage then measures all_gather's single-device cost)."""
+        from ..parallel import make_mesh, sharded_verify_round, \
+            sharded_verify_round_local
+
+        n = len(signatures)
+        mesh = getattr(self._kernels, "mesh", None)
+        if self._stage_probe is None:
+            if mesh is None:
+                mesh = make_mesh()  # every local device; 1 is fine
+            self._stage_probe = (sharded_verify_round_local(mesh),
+                                 sharded_verify_round(mesh), mesh)
+        local_fn, full_fn, mesh = self._stage_probe
+        lanes = mesh.devices.size
+        # Metrics detached around prep: the probe's synthetic batch must
+        # not pollute frontier_batch_occupancy / frontier_padded_lanes,
+        # which report what actually ships through the frontier.  (The
+        # probe is an explicit offline sample, never concurrent with a
+        # hot-path flush on the same provider.)
+        metrics, self.metrics = self.metrics, None
+        try:
+            (size, sx, ssign, sinf, sok, wpacked, rows,
+             pk_idx, pk_ok) = self._host_prep(signatures, voters, n)
+        finally:
+            self.metrics = metrics
+        if size % lanes:  # provider padded for its own kernels' lanes
+            pad = -(-size // lanes) * lanes
+            sx = np.concatenate([sx, np.zeros((pad - size, dev.FQ.n),
+                                              np.int32)])
+            ssign, sinf, sok, rows, wpacked = (
+                np.concatenate([a, np.zeros((pad - size,) + a.shape[1:],
+                                            a.dtype)])
+                for a in (ssign, sinf, sok, rows, wpacked))
+            size = pad
+        args = (jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+                jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
+                *self._pk_device())
+        if warm:  # first touch is the compile, not the stage
+            jax.block_until_ready(local_fn(*args))
+            jax.block_until_ready(full_fn(*args))
+        t0 = time.perf_counter()
+        with annotate("tpu_bls.probe.partial_reduce"):
+            local_out = local_fn(*args)
+            jax.block_until_ready(local_out)
+        t_local = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with annotate("tpu_bls.probe.full_round"):
+            jax.block_until_ready(full_fn(*args))
+        t_full = time.perf_counter() - t0
+        t_combine = max(t_full - t_local, 0.0)
+        if self.prof is not None:
+            self.prof.sharded("partial_reduce", t_local)
+            self.prof.sharded("allgather", t_combine)
+            self._shard_latencies(local_out[2], sampled=True)
+        return {"devices": int(lanes), "batch": n, "padded": int(size),
+                "partial_reduce_s": t_local, "allgather_s": t_combine,
+                "full_s": t_full}
 
     @staticmethod
     def _lane_hashes(groups: Dict[bytes, List[int]], n: int) -> List[bytes]:

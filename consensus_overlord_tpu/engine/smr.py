@@ -70,6 +70,7 @@ from ..core.types import (
     MSG_TYPE_SIGNED_VOTE,
 )
 from ..crypto.provider import CryptoProvider
+from ..obs.prof import annotate as _annotate
 from ..ports import ConsensusAdapter, Wal
 
 logger = logging.getLogger("consensus_overlord_tpu.engine")
@@ -231,6 +232,13 @@ class Engine:
         #: without a verifier actually guarding the injection path.
         self.frontier = frontier
         self.inbound_verified = frontier is not None
+        #: Optional obs.prof.ProfileSession: XLA trace capture over
+        #: whole consensus rounds.  The engine only pings it at round
+        #: boundaries (on_round decides when a capture opens/closes —
+        #: profile_every_n_rounds cadence or a /debug/profile request);
+        #: None = zero hot-path overhead.  Assigned by the service /
+        #: sim wiring, one per process (jax's profiler is global).
+        self.profile = None
         #: Optional span exporter (obs/tracing.JaegerExporter).  The
         #: reference #[instrument]s its consensus entry points
         #: (src/main.rs:91,106,132; src/consensus.rs:96,143,209); here the
@@ -554,6 +562,8 @@ class Engine:
         self.round = round_
         self.step = Step.PROPOSE
         self._trace_begin_round()
+        if self.profile is not None:
+            self.profile.on_round(self.height, round_)
         self._cancel_timers()
         if self.recorder is not None:
             self.recorder.record("enter_round", height=self.height,
@@ -1144,7 +1154,8 @@ class Engine:
         self._bind_span_ctx(span_id)  # runs as its own _spawn'd task
         ok = True
         try:
-            status = await self.adapter.commit(height, commit)
+            with _annotate("consensus.commit"):
+                status = await self.adapter.commit(height, commit)
         except Exception:  # noqa: BLE001
             logger.exception("%s: commit failed", self._tag())
             ok = False

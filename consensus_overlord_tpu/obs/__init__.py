@@ -12,6 +12,13 @@ src/main.rs:96, 111, 137).  Here:
   flightrec.py — bounded ring buffer of structured engine events (state
                  transitions, QC formation, frontier drops) for test
                  failure dumps and the /statusz tail
+  prof.py      — per-chip device profiling: staged round profiles of the
+                 device crypto ops (parse/dispatch/readback/pairing into
+                 crypto_device_stage_seconds{stage,op} + a bounded
+                 per-call ring), mesh-path gauges, and ProfileSession —
+                 the config-gated jax.profiler.trace wrapper behind
+                 profile_dir / profile_every_n_rounds and the
+                 /debug/profile?rounds=N trigger
   logctx.py    — logging init from LogConfig + W3C traceparent extraction
                  from gRPC metadata into contextvars, stamped onto every
                  log record (the `set_parent` analog); per-request server
@@ -20,21 +27,38 @@ src/main.rs:96, 111, 137).  Here:
                  dependency-free), honoring log_config.agent_endpoint
 """
 
-from .flightrec import FlightRecorder
-from .logctx import (init_logging, span_context, trace_context,
-                     TraceContextInterceptor)
-from .metrics import Metrics, MetricsInterceptor, snapshot
-from .tracing import JaegerExporter, Span
+# Lazy re-exports (PEP 562), keyed by submodule: metrics.py imports
+# grpc + prometheus_client at module load, but the consensus core
+# (engine/smr.py, crypto/frontier.py, crypto/tpu_provider.py) imports
+# obs.prof — stdlib-only — for annotate()/NULL_CALL.  Resolving the
+# heavy submodules on first attribute access keeps the engine usable
+# in environments without the gRPC service stack (metric surfaces are
+# always injected, never imported, by the core).
+_EXPORTS = {
+    "FlightRecorder": "flightrec",
+    "init_logging": "logctx",
+    "span_context": "logctx",
+    "trace_context": "logctx",
+    "TraceContextInterceptor": "logctx",
+    "Metrics": "metrics",
+    "MetricsInterceptor": "metrics",
+    "snapshot": "metrics",
+    "DeviceProfiler": "prof",
+    "ProfileSession": "prof",
+    "annotate": "prof",
+    "JaegerExporter": "tracing",
+    "Span": "tracing",
+}
 
-__all__ = [
-    "FlightRecorder",
-    "JaegerExporter",
-    "Metrics",
-    "MetricsInterceptor",
-    "Span",
-    "TraceContextInterceptor",
-    "init_logging",
-    "snapshot",
-    "span_context",
-    "trace_context",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        module = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        value = getattr(module, name)
+        globals()[name] = value  # cache: next access skips __getattr__
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -19,10 +19,27 @@ multiple nodes can live in one test process):
   frontier   frontier_batch_size, frontier_queue_wait_ms,
              frontier_batch_occupancy (real/padded lanes),
              frontier_padded_lanes_total,
-             frontier_verify_failures_total{msg_type}
+             frontier_verify_failures_total{msg_type},
+             frontier_flush_reason_total{reason} — why each batch left
+             the frontier (linger expired vs max-batch hit vs shutdown
+             drain), the key to reading the queue-wait histogram
   device     crypto_dispatch_ms{phase} — host-side phase split:
              prep (parse/pad/RLC draw), dispatch (kernel enqueue),
              readback (device round-trip), pairing (host pairing check)
+  profile    crypto_device_stage_seconds{stage,op} — the per-call staged
+             round profile (obs/prof.py DeviceProfiler): the
+             parse/dispatch/readback/pairing split per device op
+             (verify_batch / aggregate / verify_aggregated), in SECONDS
+             (device stages span 100 us sim calls to minute-long cold
+             compiles); crypto_device_batch_occupancy — gauge, real
+             lanes / padded lanes of the LAST device batch;
+             sharded_partial_reduce_seconds / sharded_allgather_seconds
+             — the mesh verify round split into per-device local work
+             vs ICI combine (sampled probe, tpu_provider
+             profile_sharded_stages); mesh_devices / device_kind{kind}
+             — the device set a provider dispatches to;
+             device_last_dispatch_seconds{device} — per-device shard
+             readback latency (skew across a v4-8 slice)
   engine     consensus_round_duration_ms, consensus_view_changes_total
              {reason}, consensus_chokes_sent_total,
              consensus_committed_heights_total,
@@ -49,6 +66,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Sequence
 
@@ -77,6 +95,12 @@ ROUND_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
 #: Real-lane fraction of a padded device batch (1.0 = the batch exactly
 #: filled its pad rung).
 OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+#: Device stage durations in SECONDS: sim-provider stages run ~100 us,
+#: a real readback over a remote PJRT link ~150 ms, a cold jit compile
+#: minutes — one family must hold all three.
+STAGE_SECONDS_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                         0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                         10.0, 60.0, 300.0)
 
 
 class Metrics:
@@ -118,6 +142,12 @@ class Metrics:
             "frontier_verify_failures_total",
             "Signatures rejected at the frontier, by message type",
             ["msg_type"], registry=self.registry)
+        self.frontier_flush_reason = Counter(
+            "frontier_flush_reason_total",
+            "Frontier batch flushes by trigger (linger = the linger "
+            "window expired, max_batch = the batch hit its size cap, "
+            "shutdown = close() drained the pending queue)",
+            ["reason"], registry=self.registry)
 
         # -- device dispatch (crypto/tpu_provider.py + frontier) ----------
         self.crypto_dispatch_ms = Histogram(
@@ -125,6 +155,44 @@ class Metrics:
             "Host-side device-path phase latency "
             "(prep/dispatch/readback/pairing)",
             ["phase"], buckets=DEVICE_BUCKETS, registry=self.registry)
+
+        # -- device profiling (obs/prof.py DeviceProfiler) ----------------
+        self.device_stage_seconds = Histogram(
+            "crypto_device_stage_seconds",
+            "Staged per-call device-op profile: parse / dispatch / "
+            "readback / pairing per op (seconds)",
+            ["stage", "op"], buckets=STAGE_SECONDS_BUCKETS,
+            registry=self.registry)
+        self.device_batch_occupancy = Gauge(
+            "crypto_device_batch_occupancy",
+            "Real lanes / padded lanes of the last device batch "
+            "dispatched (in (0, 1]; low = linger/max_batch mis-tuned)",
+            registry=self.registry)
+        self.sharded_partial_reduce_seconds = Histogram(
+            "sharded_partial_reduce_seconds",
+            "Per-device local stage of the mesh verify round (validate "
+            "+ partial MSM reduce, no collective) — sampled probe",
+            buckets=STAGE_SECONDS_BUCKETS, registry=self.registry)
+        self.sharded_allgather_seconds = Histogram(
+            "sharded_allgather_seconds",
+            "Cross-device combine stage of the mesh verify round "
+            "(all-gather of partials over ICI + replicated finish) — "
+            "sampled probe",
+            buckets=STAGE_SECONDS_BUCKETS, registry=self.registry)
+        self.mesh_devices = Gauge(
+            "mesh_devices",
+            "Devices in the crypto provider's dispatch mesh (1 = "
+            "single-chip kernels)", registry=self.registry)
+        self.device_kind = Gauge(
+            "device_kind",
+            "1 per device platform/kind present in the mesh",
+            ["kind"], registry=self.registry)
+        self.device_last_dispatch_seconds = Gauge(
+            "device_last_dispatch_seconds",
+            "Per-device shard-fetch latency of the last profiled "
+            "sharded dispatch, measured after the result completed "
+            "(each gauge is one device's D2H path; a straggling chip "
+            "is the outlier)", ["device"], registry=self.registry)
 
         # -- engine (engine/smr.py) ---------------------------------------
         self.round_duration_ms = Histogram(
@@ -196,6 +264,11 @@ class Metrics:
         #: JSON-encodable.  Registered by service/main.py (engine state,
         #: frontier stats, flight-recorder tail).
         self._status_sources: Dict[str, Callable[[], object]] = {}
+        #: /debug/* action endpoints: path → fn(query_params) returning
+        #: something JSON-encodable.  Loopback-gated like /statusz (they
+        #: mutate process state — e.g. /debug/profile starts an XLA
+        #: trace capture).  Registered by service/main.py.
+        self._debug_handlers: Dict[str, Callable[[dict], object]] = {}
 
     def interceptor(self) -> "MetricsInterceptor":
         return MetricsInterceptor(self)
@@ -207,6 +280,15 @@ class Metrics:
         """Register a /statusz section.  `fn` runs on the exporter's HTTP
         thread at request time — it must be cheap and thread-safe."""
         self._status_sources[name] = fn
+
+    def add_debug_handler(self, path: str,
+                          fn: Callable[[dict], object]) -> None:
+        """Register a loopback-only /debug action endpoint.  `fn`
+        receives the query parameters ({name: last_value}) on the
+        exporter's HTTP thread and returns a JSON-encodable reply —
+        e.g. /debug/profile?rounds=N triggers an XLA trace capture
+        (obs/prof.py ProfileSession.request)."""
+        self._debug_handlers[path] = fn
 
     def statusz(self) -> dict:
         """Assemble the /statusz document.  A failing source reports its
@@ -237,7 +319,7 @@ class Metrics:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path in ("/statusz", "/debug/vars"):
                     if not statusz_public and not _loopback(
                             self.client_address[0]):
@@ -246,6 +328,22 @@ class Metrics:
                         return
                     body = json.dumps(metrics.statusz(),
                                       default=repr).encode()
+                    ctype = "application/json"
+                elif path in metrics._debug_handlers:
+                    # Action endpoints mutate process state (e.g. start
+                    # an XLA trace): never remotely triggerable, even
+                    # with a public statusz.
+                    if not _loopback(self.client_address[0]):
+                        self.send_error(403, "debug endpoints are "
+                                        "loopback-only")
+                        return
+                    params = {k: vs[-1] for k, vs
+                              in urllib.parse.parse_qs(query).items()}
+                    try:
+                        reply = metrics._debug_handlers[path](params)
+                    except Exception as e:  # noqa: BLE001 — degrade
+                        reply = {"ok": False, "error": repr(e)}
+                    body = json.dumps(reply, default=repr).encode()
                     ctype = "application/json"
                 elif path in ("/", "/metrics"):
                     body = generate_latest(metrics.registry)

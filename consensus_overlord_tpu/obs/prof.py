@@ -1,0 +1,459 @@
+"""Per-chip device profiling: staged round profiles + XLA profiler hooks.
+
+PR 1 lit up the host side (frontier batch shape, dispatch-phase
+milliseconds, engine cadence); the device itself stayed a black box —
+`parallel/sharded.py` exported nothing, and the only stage-by-stage
+breakdown of the BLS verify pipeline lived in the manually-run
+`scripts/profile_verify.py`.  This module makes that breakdown a
+permanent, per-call surface:
+
+  DeviceProfiler   — staged per-call profiles for the device crypto ops.
+                     A provider opens a `StagedCall` per dispatch
+                     (op = verify_batch / aggregate / verify_aggregated),
+                     marks the same stage split profile_verify.py times
+                     by hand (parse / dispatch / readback / pairing),
+                     and finishes it at resolve time.  Every stage lands
+                     in `crypto_device_stage_seconds{stage,op}`; the
+                     batch's real/padded shape drives the
+                     `crypto_device_batch_occupancy` gauge; the finished
+                     record enters a bounded ring (the flightrec
+                     pattern) served under /statusz "profile" and
+                     embedded in sim/run.py + bench_round.py JSON.
+                     Mesh visibility: `set_devices` fills `mesh_devices`
+                     / `device_kind{kind}`, `device_latency` tracks
+                     per-device last-dispatch skew
+                     (`device_last_dispatch_seconds{device}`), and
+                     `sharded` records the partial-reduce vs all-gather
+                     split (`sharded_partial_reduce_seconds` /
+                     `sharded_allgather_seconds`) measured by the
+                     provider's staged mesh probe.
+
+  ProfileSession   — config-gated wrapper over `jax.profiler.trace`:
+                     `profile_dir` + `profile_every_n_rounds` in the
+                     service config (or `/debug/profile?rounds=N` on the
+                     metrics port) capture XLA traces of whole consensus
+                     rounds; `annotate()` stamps TraceAnnotations on
+                     frontier flushes, device dispatches, and engine
+                     commits so the captured timeline lines up with the
+                     tracing spans.  Everything degrades to a clean
+                     no-op when `jax.profiler` is unavailable or no
+                     profile_dir is configured.
+
+Design constraints (same posture as flightrec.py): recording sits on
+the dispatch/resolve hot path — no formatting, no I/O, never raises;
+rings are bounded; every hook is optional (`prof=None` keeps the
+instrumented code on its pre-profiling path).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger("consensus_overlord_tpu.prof")
+
+__all__ = ["DeviceProfiler", "ProfileSession", "StagedCall", "annotate"]
+
+# The stage split is the one scripts/profile_verify.py established —
+# parse (host prep incl. pad + RLC draw), dispatch (kernel enqueue
+# returning), readback (the blocking D2H device_get), pairing (the
+# host pairing check) — each boundary a host-observable point.
+# Stage names are free-form strings chosen by the instrumented
+# provider; there is deliberately no enum to keep recording open.
+
+_profiler_mod = None
+_profiler_checked = False
+
+
+def _jax_profiler():
+    """jax.profiler, resolved lazily (obs/ must stay importable in
+    processes that never touch jax), or None when unavailable."""
+    global _profiler_mod, _profiler_checked
+    if not _profiler_checked:
+        _profiler_checked = True
+        try:
+            from jax import profiler as p  # noqa: PLC0415 — lazy by design
+            _profiler_mod = p
+        except Exception:  # noqa: BLE001 — absent/broken jax: no-op mode
+            _profiler_mod = None
+    return _profiler_mod
+
+
+def annotate(name: str):
+    """A TraceAnnotation context for `name` — XLA traces captured by a
+    ProfileSession show the annotated host span aligned with the device
+    ops it enqueued.  A cheap TraceMe no-op while no trace is active,
+    and a nullcontext when jax.profiler is unavailable."""
+    prof = _jax_profiler()
+    if prof is None:
+        return nullcontext()
+    try:
+        return prof.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — profiling never breaks the path
+        return nullcontext()
+
+
+class StagedCall:
+    """One in-flight device-path call being profiled.  Created by
+    `DeviceProfiler.begin`; the provider observes stage durations as it
+    crosses each boundary (possibly from different threads — dispatch
+    happens on the frontier's worker, resolve on a resolver thread; the
+    stages are strictly sequential in time, so plain attribute writes
+    are safe) and calls `finish()` once the result is in hand."""
+
+    __slots__ = ("_prof", "op", "batch", "padded", "ts", "stages", "_done")
+
+    def __init__(self, prof: "DeviceProfiler", op: str, batch: int,
+                 padded: Optional[int] = None):
+        self._prof = prof
+        self.op = op
+        self.batch = int(batch)
+        self.padded = int(padded) if padded else None
+        self.ts = time.time()
+        self.stages: Dict[str, float] = {}
+        self._done = False
+
+    def observe(self, stage: str, seconds: float) -> None:
+        """One stage took `seconds`.  Repeated observations of a stage
+        accumulate (a split dispatch plan crosses 'dispatch' once per
+        sub-batch)."""
+        try:
+            self.stages[stage] = self.stages.get(stage, 0.0) + seconds
+            self._prof.observe_stage(self.op, stage, seconds)
+        except Exception:  # noqa: BLE001 — profiling never breaks crypto
+            pass
+
+    def pad(self, batch: int, padded: int) -> None:
+        """Record the batch's padded shape (drives the occupancy gauge)."""
+        try:
+            self.batch = int(batch)
+            self.padded = int(padded)
+            self._prof.occupancy(batch, padded)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def finish(self, ok: bool = True) -> None:
+        """Push the completed record into the profiler's ring.  Safe to
+        call more than once (only the first wins) and never raises."""
+        if self._done:
+            return
+        self._done = True
+        try:
+            self._prof.complete(self, ok)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class _NullCall:
+    """The no-profiler twin of StagedCall: every hook is a no-op, so
+    instrumented providers run one truthy-check of overhead when no
+    profiler is bound."""
+
+    __slots__ = ()
+
+    def observe(self, stage: str, seconds: float) -> None:
+        pass
+
+    def pad(self, batch: int, padded: int) -> None:
+        pass
+
+    def finish(self, ok: bool = True) -> None:
+        pass
+
+
+NULL_CALL = _NullCall()
+
+
+class DeviceProfiler:
+    """The device-side profile surface: staged per-call records + mesh
+    gauges, optionally mirrored into an obs.Metrics registry.
+
+    One per node (like Metrics); `capacity` bounds the per-call ring so
+    observability can't grow memory under sustained load."""
+
+    #: Floor between per-device shard-latency samples.  Each sample
+    #: costs one blocking D2H read PER DEVICE (~150 ms each over a
+    #: remote PJRT link) serialized ahead of the batch's fused
+    #: device_get, so it must never ride every hot-path resolve — the
+    #: throttle keeps live skew visibility at a bounded, amortized cost.
+    DEVICE_SAMPLE_INTERVAL_S = 30.0
+
+    def __init__(self, metrics=None, capacity: int = 256,
+                 device_sample_interval_s: Optional[float] = None):
+        self.metrics = metrics
+        self._ring: deque = deque(maxlen=max(int(capacity), 1))
+        self._seq = 0
+        self._lock = threading.Lock()  # seq + cumulative stage totals
+        self._device_sample_interval = (
+            self.DEVICE_SAMPLE_INTERVAL_S if device_sample_interval_s is None
+            else device_sample_interval_s)
+        self._last_device_sample = 0.0
+        #: cumulative {op: {stage: [count, total_seconds]}} — the cheap
+        #: aggregation sim/run.py & bench_round.py embed in their JSON
+        #: without needing a registry scrape.
+        self._totals: Dict[str, Dict[str, List[float]]] = {}
+        self._last_occupancy: Optional[float] = None
+        self._devices: List[str] = []
+        self._device_latency: Dict[str, float] = {}
+
+    # -- staged calls ------------------------------------------------------
+
+    def begin(self, op: str, batch: int,
+              padded: Optional[int] = None) -> StagedCall:
+        return StagedCall(self, op, batch, padded)
+
+    def observe_stage(self, op: str, stage: str, seconds: float) -> None:
+        with self._lock:
+            per_op = self._totals.setdefault(op, {})
+            tot = per_op.setdefault(stage, [0, 0.0])
+            tot[0] += 1
+            tot[1] += seconds
+        if self.metrics is not None:
+            self.metrics.device_stage_seconds.labels(
+                stage=stage, op=op).observe(seconds)
+
+    def occupancy(self, batch: int, padded: int) -> None:
+        """Real lanes / padded lanes of the batch being dispatched."""
+        if padded <= 0:
+            return
+        occ = batch / padded
+        self._last_occupancy = occ
+        if self.metrics is not None:
+            self.metrics.device_batch_occupancy.set(occ)
+
+    def complete(self, call: StagedCall, ok: bool) -> None:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        record = {"seq": seq, "ts": call.ts, "op": call.op,
+                  "batch": call.batch, "ok": bool(ok),
+                  "stages_s": {k: round(v, 6)
+                               for k, v in call.stages.items()}}
+        if call.padded:
+            record["padded"] = call.padded
+            record["occupancy"] = round(call.batch / call.padded, 4)
+        self._ring.append(record)
+
+    # -- mesh-path visibility ---------------------------------------------
+
+    def set_devices(self, devices: Sequence) -> None:
+        """Record the device set a provider dispatches to: `mesh_devices`
+        (count) + `device_kind{kind}` (1 per distinct platform/kind
+        present — a heterogeneous slice is itself a finding)."""
+        try:
+            names = [f"{getattr(d, 'platform', d)}:"
+                     f"{getattr(d, 'id', i)}" for i, d in enumerate(devices)]
+            kinds = sorted({str(getattr(d, "device_kind",
+                                        getattr(d, "platform", "unknown")))
+                            for d in devices})
+        except Exception:  # noqa: BLE001 — exotic device objects
+            names, kinds = [str(d) for d in devices], ["unknown"]
+        self._devices = names
+        if self.metrics is not None:
+            self.metrics.mesh_devices.set(len(names))
+            for kind in kinds:
+                self.metrics.device_kind.labels(kind=kind).set(1)
+
+    def want_device_sample(self) -> bool:
+        """Should the caller pay for a per-device shard-latency sample
+        now?  True at most once per device_sample_interval_s (first ask
+        always samples); the sampled probe paths bypass this."""
+        with self._lock:
+            now = time.monotonic()
+            if now - self._last_device_sample < self._device_sample_interval:
+                return False
+            self._last_device_sample = now
+            return True
+
+    def device_latency(self, device: str, seconds: float) -> None:
+        """Per-device shard-fetch latency from the last profiled
+        sharded dispatch, measured after the result completed — each
+        gauge is one device's D2H path alone, so a straggling or
+        degraded chip stands out as the outlier."""
+        self._device_latency[str(device)] = seconds
+        if self.metrics is not None:
+            self.metrics.device_last_dispatch_seconds.labels(
+                device=str(device)).set(seconds)
+
+    def sharded(self, phase: str, seconds: float) -> None:
+        """One mesh-probe observation: phase is 'partial_reduce' (the
+        per-device local validate+MSM work) or 'allgather' (the ICI
+        combine: all-gather of D partials + replicated log2(D) finish)."""
+        if self.metrics is None:
+            return
+        if phase == "partial_reduce":
+            self.metrics.sharded_partial_reduce_seconds.observe(seconds)
+        elif phase == "allgather":
+            self.metrics.sharded_allgather_seconds.observe(seconds)
+
+    # -- read side ---------------------------------------------------------
+
+    def tail(self, n: Optional[int] = None) -> List[dict]:
+        """Newest `n` per-call records, oldest first."""
+        records = list(self._ring)  # snapshot: writers may be appending
+        if n is not None:
+            records = records[-n:] if n > 0 else []
+        return records
+
+    def stage_totals(self) -> Dict[str, dict]:
+        """Cumulative {op/stage: {count, total_s}} — the JSON-summary
+        form of crypto_device_stage_seconds."""
+        with self._lock:
+            return {f"{op}/{stage}": {"count": int(c),
+                                      "total_s": round(s, 6)}
+                    for op, stages in self._totals.items()
+                    for stage, (c, s) in stages.items()}
+
+    def summary(self) -> dict:
+        """The "profile" block sim/run.py / bench_round.py embed."""
+        return {
+            "crypto_device_stage_seconds": self.stage_totals(),
+            "occupancy": self._last_occupancy,
+            "devices": self._devices,
+            "device_last_dispatch_s": {k: round(v, 6) for k, v
+                                       in self._device_latency.items()},
+            "calls": len(self._ring),
+        }
+
+    def statusz(self, tail: int = 32) -> dict:
+        """The /statusz "profile" section: summary + the recent ring."""
+        doc = self.summary()
+        doc["recent"] = self.tail(tail)
+        return doc
+
+
+class ProfileSession:
+    """Config-gated XLA trace capture over `jax.profiler.trace`.
+
+    profile_dir      — where trace subdirectories land; None/"" disables
+                       everything (every method a clean no-op).
+    every_n_rounds   — start a one-round capture at every Nth round the
+                       attached engine enters (0 = only explicit
+                       requests via `request()` / the
+                       /debug/profile?rounds=N trigger).
+
+    The engine calls `on_round(height, round)` at each round entry
+    (engine/smr.py); captures open and close on those boundaries so a
+    trace file holds whole consensus rounds, aligned with the
+    `annotate()`d frontier/dispatch/commit host spans.  jax's profiler
+    is process-global, so attach one session per process (the service
+    wires the running engine's; sim fleets attach node 0's)."""
+
+    def __init__(self, profile_dir: Optional[str] = None,
+                 every_n_rounds: int = 0):
+        self.profile_dir = profile_dir or None
+        self.every_n_rounds = max(int(every_n_rounds or 0), 0)
+        self._lock = threading.Lock()
+        self._active_dir: Optional[str] = None
+        self._last_dir: Optional[str] = None
+        self._rounds_left = 0
+        self._round_ix = 0
+        self._requested = 0
+        self._captures = 0
+
+    @property
+    def available(self) -> bool:
+        """Can this session capture at all?  (profile_dir configured AND
+        jax.profiler importable.)"""
+        return self.profile_dir is not None and _jax_profiler() is not None
+
+    @property
+    def active(self) -> bool:
+        return self._active_dir is not None
+
+    # -- capture control ---------------------------------------------------
+
+    def start(self, rounds: int = 1, label: str = "manual") -> bool:
+        """Begin a capture spanning the next `rounds` round entries (or
+        until stop()).  False — never an exception — when unavailable or
+        already tracing (jax's profiler is process-global)."""
+        prof = _jax_profiler()
+        if prof is None or self.profile_dir is None:
+            return False
+        with self._lock:
+            if self._active_dir is not None:
+                return False
+            trace_dir = (f"{self.profile_dir}/"
+                         f"{label}_{int(time.time() * 1000):x}")
+            try:
+                prof.start_trace(trace_dir)
+            except Exception as e:  # noqa: BLE001 — another tracer active
+                logger.warning("profile start failed: %s", e)
+                return False
+            self._active_dir = trace_dir
+            self._rounds_left = max(int(rounds), 1)
+            self._captures += 1
+            return True
+
+    def stop(self) -> Optional[str]:
+        """End the capture; returns the trace directory (None if no
+        capture was active)."""
+        prof = _jax_profiler()
+        with self._lock:
+            if self._active_dir is None:
+                return None
+            trace_dir, self._active_dir = self._active_dir, None
+            self._last_dir = trace_dir
+            self._rounds_left = 0
+            try:
+                if prof is not None:
+                    prof.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("profile stop failed: %s", e)
+            return trace_dir
+
+    def request(self, rounds: int = 1) -> dict:
+        """The /debug/profile?rounds=N trigger: capture the next N rounds
+        (starting at the next round boundary).  Returns a status dict
+        (JSON-encodable) describing what will happen."""
+        if not self.available:
+            return {"ok": False,
+                    "reason": ("profile_dir not configured"
+                               if self.profile_dir is None
+                               else "jax.profiler unavailable")}
+        with self._lock:
+            self._requested = max(int(rounds), 1)
+        return {"ok": True, "rounds": self._requested,
+                "dir": self.profile_dir}
+
+    def on_round(self, height: int, round_: int) -> None:
+        """Round-boundary hook (engine/smr.py _enter_round).  Closes a
+        capture whose round budget is spent, then opens one when a
+        /debug/profile request is pending or the every_n_rounds cadence
+        hits.  Hot-path cheap; never raises."""
+        try:
+            self._round_ix += 1
+            if self.active:
+                self._rounds_left -= 1
+                if self._rounds_left > 0:
+                    return
+                # Fall through after closing: this same boundary may
+                # start the next capture (every_n_rounds=1 means EVERY
+                # round, and a pending request must not slip a round).
+                self.stop()
+            if not self.available or self.active:
+                return
+            if self._requested > 0:
+                rounds, self._requested = self._requested, 0
+                self.start(rounds, label=f"req_h{height}")
+            elif (self.every_n_rounds
+                  and self._round_ix % self.every_n_rounds == 0):
+                self.start(1, label=f"round_h{height}_r{round_}")
+        except Exception:  # noqa: BLE001 — profiling never breaks SMR
+            pass
+
+    def status(self) -> dict:
+        """JSON-encodable snapshot for /statusz."""
+        return {
+            "available": self.available,
+            "dir": self.profile_dir,
+            "active": self.active,
+            "every_n_rounds": self.every_n_rounds,
+            "captures": self._captures,
+            "last_capture_dir": self._last_dir,
+            "pending_request": self._requested,
+        }

@@ -20,6 +20,7 @@ _SHARDED = (
     "sharded_g2_validate",
     "sharded_round_step",
     "sharded_verify_round",
+    "sharded_verify_round_local",
     "sharded_verify_round_multi",
 )
 

@@ -99,6 +99,35 @@ def sharded_verify_round(mesh: Mesh, axis: str = AXIS):
     return jax.jit(fn)
 
 
+def sharded_verify_round_local(mesh: Mesh, axis: str = AXIS):
+    """The collective-free twin of sharded_verify_round: identical
+    per-device work (weight unpack, G1 validate + partial MSM, pubkey
+    gather + partial G2 MSM) but NO cross-device combine — every output
+    stays sharded.  Exists for the staged mesh probe
+    (tpu_provider.profile_sharded_stages → sharded_partial_reduce_seconds
+    / sharded_allgather_seconds): timing this against the full kernel
+    splits a round into per-device local compute vs the ICI all-gather +
+    replicated finish, which one fused program can't expose.  Not a
+    verification path — partials are never checked."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
+                       P(axis), P(), P(), P()),
+             out_specs=(P(axis), P(axis), P(axis)))
+    def fn(x, sign, inf, ok, wpacked, rows, pkx, pky, pkz):
+        bits = dev.unpack_weight_bits(wpacked)
+        pt, valid = dev.g1_validate_batch(x, sign, inf, ok)
+        agg = dev.G1.msm_bits(pt, bits)
+        vbits = bits * valid[..., None].astype(bits.dtype)
+        pk = dev.gather_rows(rows, pkx, pky, pkz)
+        gagg = dev.G2.msm_bits(pk, vbits)
+        # One coordinate per partial is enough to force the compute;
+        # shipping full projective points would just inflate the D2H.
+        return agg.x, gagg.x, valid
+
+    return jax.jit(fn)
+
+
 def sharded_verify_round_multi(mesh: Mesh, axis: str = AXIS):
     """k-hash fused verification round over the mesh (sharded twin of
     tpu_provider.verify_round_multi_fn): the group-membership mask

@@ -65,6 +65,19 @@ class ConsensusConfig:
     #: Events served in the /statusz flight-recorder tail (bounded so a
     #: scrape never ships the whole ring).
     statusz_tail: int = 64
+    #: XLA profiler captures (obs/prof.py ProfileSession): directory
+    #: trace subdirs land in.  None/"" disables capture entirely —
+    #: profile_every_n_rounds and /debug/profile then no-op.  The
+    #: staged round profiles (crypto_device_stage_seconds + the
+    #: /statusz "profile" ring) are independent of this and always on
+    #: when metrics are.
+    profile_dir: Optional[str] = None
+    #: Capture a one-round XLA trace at every Nth consensus round
+    #: (0 = only explicit /debug/profile?rounds=N triggers).
+    profile_every_n_rounds: int = 0
+    #: Per-call records kept in the device-profile ring (served under
+    #: /statusz "profile"; bounded like the flight recorder).
+    profile_ring_capacity: int = 256
     #: /statusz + /debug/vars answer loopback clients only unless this is
     #: set: they expose live consensus position and the flight-recorder
     #: tail, which is reconnaissance material on a routable host.

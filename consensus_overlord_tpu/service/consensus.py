@@ -37,6 +37,7 @@ from ..core.types import (
 )
 from ..crypto.frontier import BatchingVerifier
 from ..engine.smr import Engine
+from ..obs.prof import DeviceProfiler, ProfileSession
 from ..engine.wal import FileWal
 from .brain import GrpcBrain
 from .config import ConsensusConfig
@@ -98,6 +99,18 @@ class Consensus:
         bind = getattr(self.crypto, "bind_metrics", None)
         if bind is not None and metrics is not None:
             bind(metrics)
+        # Device profiling: staged round profiles (per-call stage split
+        # + the /statusz "profile" ring) whenever metrics are on, and
+        # the config-gated XLA capture session (profile_dir /
+        # profile_every_n_rounds / the /debug/profile trigger).
+        self.profiler = (DeviceProfiler(metrics,
+                                        config.profile_ring_capacity)
+                         if metrics is not None else None)
+        bindp = getattr(self.crypto, "bind_profiler", None)
+        if bindp is not None and self.profiler is not None:
+            bindp(self.profiler)
+        self.profile_session = ProfileSession(
+            config.profile_dir, config.profile_every_n_rounds)
         # The device breaker's transitions belong in the same event ring
         # as the engine's (degraded mode is exactly when the post-mortem
         # needs an interleaved timeline).
@@ -110,6 +123,9 @@ class Consensus:
         self.engine = Engine(self.crypto.pub_key, self.brain, self.crypto,
                              self.wal, frontier=self.frontier, tracer=tracer,
                              metrics=metrics, recorder=recorder)
+        # Round-boundary pings drive the capture cadence; attaching here
+        # (not in main.py) keeps embedded uses — tests, sim — working.
+        self.engine.profile = self.profile_session
         #: Last applied configuration (reference `reconfigure:
         #: Arc<RwLock<Option<ConsensusConfiguration>>>`, src/consensus.rs:55).
         self.reconfigure: Optional[pb2.ConsensusConfiguration] = None

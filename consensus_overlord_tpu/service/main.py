@@ -116,6 +116,18 @@ class ServiceRuntime:
                 tail_n = cfg.statusz_tail
                 self.metrics.add_status_source(
                     "flightrec", lambda: recorder.tail(tail_n))
+            # Device profiling: the staged-round profile ring + mesh
+            # gauges, the capture session's state, and the loopback-only
+            # /debug/profile?rounds=N trigger (obs/prof.py).
+            profiler = self.consensus.profiler
+            session = self.consensus.profile_session
+            if profiler is not None:
+                self.metrics.add_status_source(
+                    "profile", lambda: {**profiler.statusz(),
+                                        "session": session.status()})
+                self.metrics.add_debug_handler(
+                    "/debug/profile",
+                    lambda q: session.request(int(q.get("rounds", "1"))))
         interceptors = [TraceContextInterceptor(exporter=self.tracer)]
         if self.metrics is not None:
             interceptors.append(self.metrics.interceptor())

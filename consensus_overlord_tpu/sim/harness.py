@@ -73,7 +73,7 @@ class SimNode:
                  controller: SimController, wal: Optional[Wal] = None,
                  use_frontier: bool = False, frontier_max_batch: int = 1024,
                  frontier_linger_s: float = 0.002, metrics=None,
-                 recorder=None, node_seed: int = 0):
+                 recorder=None, node_seed: int = 0, profiler=None):
         from ..crypto.frontier import BatchingVerifier
         from .adversary import AdversaryShim
 
@@ -93,6 +93,11 @@ class SimNode:
             bind = getattr(crypto, "bind_metrics", None)
             if bind is not None:
                 bind(metrics)
+        if profiler is not None:
+            bindp = getattr(crypto, "bind_profiler", None)
+            if bindp is not None:
+                bindp(profiler)
+        self.profiler = profiler
         breaker = getattr(crypto, "breaker", None)
         if breaker is not None and recorder is not None:
             breaker.recorder = recorder
@@ -160,9 +165,13 @@ class SimNetwork:
                  frontier_linger_s: float = 0.002, metrics=None,
                  flight_recorder_capacity: int = 0, wal_factory=None,
                  sim_device_crypto: bool = False,
-                 device_breaker_cooldown_s: float = 0.25):
+                 device_breaker_cooldown_s: float = 0.25,
+                 profiler=None):
         """metrics: one shared obs.Metrics for the whole fleet (histograms
         aggregate across nodes — fine for sim-level batch/round shape).
+        profiler: one shared obs.prof.DeviceProfiler — providers with a
+        device path (TpuBlsCrypto, SimDeviceCrypto) then record staged
+        per-call round profiles into it.
         flight_recorder_capacity > 0 gives every node its own event ring;
         dump_flight_recorders() renders them all for failure forensics.
         wal_factory(i) -> Wal gives node i a durable WAL (chaos runs pass
@@ -202,6 +211,7 @@ class SimNetwork:
         self.controller = SimController(
             [c.pub_key for c in cryptos], block_interval_ms)
         self.metrics = metrics
+        self.profiler = profiler
         self._use_frontier = use_frontier
         self._frontier_linger_s = frontier_linger_s
         self._wal_factory = wal_factory
@@ -214,7 +224,8 @@ class SimNetwork:
                               recorder=(FlightRecorder(
                                   flight_recorder_capacity)
                                   if flight_recorder_capacity > 0 else None),
-                              node_seed=seed ^ (0x9E3779B9 * (i + 1)))
+                              node_seed=seed ^ (0x9E3779B9 * (i + 1)),
+                              profiler=profiler)
                       for i, c in enumerate(cryptos)]
         self.controller.on_new_height.append(self._push_status)
 
@@ -264,10 +275,15 @@ class SimNetwork:
                        use_frontier=self._use_frontier,
                        frontier_linger_s=self._frontier_linger_s,
                        metrics=self.metrics, recorder=old.recorder,
-                       node_seed=old.adversary.seed)
+                       node_seed=old.adversary.seed,
+                       profiler=self.profiler)
         # Adversary tallies span the crash like the flight recorder does
         # (run assertions read them after the schedule has played out).
         node.adversary.behavior_stats = old.adversary.behavior_stats
+        # The XLA capture session (if sim/run.py attached one to this
+        # node's engine) survives the restart too — a crashed node 0
+        # must not silently end profiling for the rest of the run.
+        node.engine.profile = old.engine.profile
         self.nodes[i] = node
         node.start(self.controller.latest_height + 1,
                    self.controller.block_interval_ms,

@@ -88,7 +88,10 @@ def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
 def main() -> None:
     parser = argparse.ArgumentParser(description="in-process consensus fleet")
     parser.add_argument("--validators", type=int, default=4)
-    parser.add_argument("--heights", type=int, default=5)
+    parser.add_argument("--heights", "--target-height", type=int, default=5,
+                        dest="heights",
+                        help="commit this many heights (--target-height "
+                        "is an alias)")
     parser.add_argument("--interval-ms", type=int, default=100)
     parser.add_argument("--drop-rate", type=float, default=0.0)
     parser.add_argument("--seed", type=int, default=0,
@@ -171,6 +174,17 @@ def main() -> None:
     parser.add_argument("--statusz-port", type=int, default=None,
                         help="serve /metrics + /statusz on this port for "
                         "the duration of the run (0 = OS-assigned)")
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture XLA profiler traces into this "
+                        "directory (obs/prof.py ProfileSession; node 0's "
+                        "engine drives the round-boundary cadence — "
+                        "jax's profiler is process-global).  The staged "
+                        "round profiles in the JSON summary are "
+                        "independent of this and always on")
+    parser.add_argument("--profile-every-n-rounds", type=int, default=0,
+                        help="with --profile-dir: capture a one-round "
+                        "trace at every Nth round (0 = capture the "
+                        "first round only)")
     parser.add_argument("--flightrec", type=int, default=256,
                         help="per-node flight-recorder capacity (events); "
                         "rings are dumped if the run times out.  0 = off")
@@ -268,9 +282,15 @@ def main() -> None:
     async def run() -> dict:
         import tempfile
 
-        from ..obs import Metrics, snapshot
+        from ..obs import DeviceProfiler, Metrics, ProfileSession, snapshot
 
         metrics = Metrics()
+        # Staged round profiles ride every run (the "profile" block in
+        # the JSON summary); XLA capture only when --profile-dir names
+        # a destination.
+        profiler = DeviceProfiler(metrics)
+        session = ProfileSession(args.profile_dir,
+                                 args.profile_every_n_rounds)
         wal_tmp = None
         wal_factory = None
         if args.chaos:
@@ -290,7 +310,14 @@ def main() -> None:
                          metrics=metrics,
                          flight_recorder_capacity=args.flightrec,
                          wal_factory=wal_factory,
-                         sim_device_crypto=args.chaos_device_faults > 0)
+                         # Always wrap breaker-less providers in the
+                         # simulated device path: exact results either
+                         # way, and every run then exports the staged
+                         # device profile (crypto_device_stage_seconds
+                         # + occupancy) — the acceptance surface of the
+                         # "profile" summary block — with zero hardware.
+                         sim_device_crypto=True,
+                         profiler=profiler)
         statusz_port = None
         if args.statusz_port is not None:
             # The fleet shares one registry; statusz reports node 0's
@@ -308,9 +335,21 @@ def main() -> None:
             degraded = getattr(net.nodes[0].crypto, "degraded_status", None)
             if degraded is not None:
                 metrics.add_status_source("crypto", degraded)
+            metrics.add_status_source(
+                "profile", lambda: {**profiler.statusz(),
+                                    "session": session.status()})
+            metrics.add_debug_handler(
+                "/debug/profile",
+                lambda q: session.request(int(q.get("rounds", "1"))))
             statusz_port = metrics.start_exporter(args.statusz_port,
                                                   addr="127.0.0.1")
             print(f"statusz: http://127.0.0.1:{statusz_port}/statusz")
+        # Node 0's engine drives the capture cadence (jax's profiler is
+        # process-global — one session per process); without an explicit
+        # cadence, capture the first committed round.
+        net.nodes[0].engine.profile = session
+        if session.available and args.profile_every_n_rounds == 0:
+            session.request(1)
         net.start(init_height=1)
         chaos = None
         if args.chaos:
@@ -417,6 +456,10 @@ def main() -> None:
         # fleet is still live so registered/partition state is truthful.
         router_stats = net.router.stats()
         await net.stop()
+        # A capture the run ended mid-window must still flush its trace;
+        # in the common case the capture already closed at a round
+        # boundary, so fall back to where that one landed.
+        trace_dir = session.stop() or session.status()["last_capture_dir"]
         if wal_tmp is not None:
             wal_tmp.cleanup()
         srt = sorted(height_ms)
@@ -457,6 +500,13 @@ def main() -> None:
             "router": router_stats,
             **frontier,
             "metrics": obs,
+            # Staged device profile: cumulative stage split per op,
+            # last-batch occupancy, the recent per-call ring, and the
+            # capture session's disposition (obs/prof.py).
+            "profile": {**profiler.summary(),
+                        "recent": profiler.tail(16),
+                        "session": session.status(),
+                        "trace_dir": trace_dir},
         }
         if chaos is not None:
             out["chaos"] = {

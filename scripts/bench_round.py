@@ -224,9 +224,12 @@ async def main():
         # scale's batch shape, not a mix across the sweep.  The provider
         # binds to it too (dispatch-phase split: prep/dispatch/readback/
         # pairing).
-        from consensus_overlord_tpu.obs import Metrics, snapshot
+        from consensus_overlord_tpu.obs import (DeviceProfiler, Metrics,
+                                                snapshot)
         metrics = Metrics()
+        prof = DeviceProfiler(metrics)
         provider.bind_metrics(None)  # rep 0 (compiles) runs unmetered
+        provider.bind_profiler(None)
 
         lat, fstats = [], []
         qc_payload = None
@@ -239,6 +242,7 @@ async def main():
                 metrics=metrics if rep > 0 else None)
             if rep == 0:
                 provider.bind_metrics(metrics)  # compiles are done now
+                provider.bind_profiler(prof)
                 first = dt
             else:
                 lat.append(dt)
@@ -264,6 +268,7 @@ async def main():
         shape = snapshot(metrics.registry, prefix="frontier")
         shape.update(snapshot(metrics.registry, prefix="crypto_dispatch"))
         shape.update(snapshot(metrics.registry, prefix="consensus_round"))
+        shape.update(snapshot(metrics.registry, prefix="crypto_device"))
         print(json.dumps({
             "metric": "consensus_round_p50_ms", "validators": n,
             "rounds": ROUNDS,
@@ -275,6 +280,10 @@ async def main():
                 round(sum(batches) / len(batches), 1),
             "pubkey_cache_fill_s": round(t_pk, 1),
             "metrics": shape,
+            # Staged device profile (obs/prof.py): per-op stage split +
+            # last-batch occupancy — the per-chip view of where the
+            # leader's round actually went.
+            "profile": {**prof.summary(), "recent": prof.tail(8)},
         }), flush=True)
 
 

@@ -1,85 +1,173 @@
-"""Break down verify_batch wall time into stages on the real device.
+"""Stage-by-stage breakdown of the device verify pipeline, on the real
+device or CPU lanes — built on the permanent profiling layer
+(consensus_overlord_tpu/obs/prof.py) instead of ad-hoc timers, so what
+this script reports is exactly what production exports as
+`crypto_device_stage_seconds{stage,op}` / the /statusz "profile" ring.
 
-Usage:  python scripts/profile_verify.py [N]
-
-Stages timed separately (each with block_until_ready):
-  parse      — host parse of N compressed G1 sigs
-  round      — the fused device kernel (G1 validate+MSM, pubkey-cache
-               gather + G2 MSM) INCLUDING the H2D upload + dispatch
+Stages (each boundary bounded by block_until_ready, recorded by the
+provider's own instrumentation):
+  parse      — host prep of N compressed G1 sigs (parse/pad/RLC draw)
+  dispatch   — the fused round kernel enqueue (G1 validate+MSM,
+               pubkey-cache gather + G2 MSM) incl. the H2D upload
   readback   — device_get of the round outputs
   pairing    — host 2-pairing batch check (native backend if built)
-  full       — end-to-end provider.verify_batch
+
+--sharded-probe adds the mesh stage split (per-device partial reduce vs
+ICI all-gather, TpuBlsCrypto.profile_sharded_stages); --profile-dir
+captures an XLA trace of one measured batch through ProfileSession.
+
+Usage:  python scripts/profile_verify.py [N] [--iters K] [--json]
+            [--cpu] [--sharded-probe] [--profile-dir DIR]
+
+Emits one {"metric": ...} JSON line on stdout (the bench_round.py
+contract; human-readable stage lines go to stderr), so CI can smoke-run
+it on CPU lanes and ledger the output.  N defaults to 1024 on an
+accelerator and 8 on CPU (a 1024-lane kernel compile is minutes of CPU
+LLVM time and profiles nothing the 8-lane rung doesn't).
 """
 
-import os
+import argparse
+import json
 import sys
 import time
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".."))
+try:
+    import consensus_overlord_tpu  # noqa: F401 — the installed package
+except ModuleNotFoundError:  # bare checkout: fall back to the repo root
+    import os
 
-import jax
-import numpy as np
-
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
-
-
-def timeit(label, fn, iters=4):
-    fn()  # warm
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn()
-    dt = (time.perf_counter() - t0) / iters
-    print(f"{label:12s} {dt * 1e3:9.2f} ms", flush=True)
-    return out, dt
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
-def main():
+def _fixture(n: int):
+    """N (sig, hash, pubkey) triples on one message hash.  Reuses
+    bench.py's disk-cached fixture when the repo root is importable
+    (same cache file + message, so the two tools can't drift apart);
+    otherwise rebuilds with bench's exact key schedule."""
+    try:
+        import bench
+
+        bench.N, bench.HASHES = n, 1
+        sigs, hashes, pks = bench._fixture()
+        return sigs, hashes[0], pks
+    except ModuleNotFoundError:  # installed package, no repo checkout
+        from consensus_overlord_tpu.core.sm3 import sm3_hash
+        from consensus_overlord_tpu.crypto import bls12381 as oracle
+
+        h = sm3_hash(b"bench-block-hash")
+        sks = [0xBEEF + 97 * i for i in range(n)]
+        return ([oracle.sign(sk, h) for sk in sks], h,
+                [oracle.sk_to_pk(sk) for sk in sks])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="staged profile of TpuBlsCrypto.verify_batch")
+    ap.add_argument("n", nargs="?", type=int, default=None,
+                    help="batch lanes (default: 1024 on an accelerator, "
+                    "8 on CPU)")
+    ap.add_argument("--iters", type=int, default=4,
+                    help="measured iterations after the warm-up rep")
+    ap.add_argument("--json", action="store_true",
+                    help="(kept for compatibility — the JSON tail is "
+                    "always emitted; this silences the stderr stage "
+                    "lines)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU lanes (the CI smoke configuration)")
+    ap.add_argument("--sharded-probe", action="store_true",
+                    help="also run the mesh stage probe (partial-reduce "
+                    "vs all-gather split; compiles two extra kernels)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="capture an XLA trace of one measured batch "
+                    "into this directory (ProfileSession)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     from consensus_overlord_tpu.compile_cache import enable
+
     enable()
-    import jax.numpy as jnp
+    import jax
 
-    from consensus_overlord_tpu.crypto import bls12381 as oracle
     from consensus_overlord_tpu.crypto import tpu_provider as tp
-    from consensus_overlord_tpu.ops import bls12381_groups as dev
+    from consensus_overlord_tpu.obs import (DeviceProfiler, Metrics,
+                                            ProfileSession)
 
-    print(f"device: {jax.devices()[0].platform}  N={N}", flush=True)
-    # Reuse bench.py's fixture (same cache file + message) so the two
-    # tools can never drift apart on what they measure.
-    import bench
-    bench.N = N
-    sigs, h, pks = bench._fixture()
+    say = (lambda *a: None) if args.json else (
+        lambda *a: print(*a, file=sys.stderr, flush=True))
+    platform = jax.devices()[0].platform
+    n = args.n if args.n is not None else (8 if platform == "cpu" else 1024)
+    say(f"device: {platform}  N={n}")
 
-    provider = tp.TpuBlsCrypto(0xA11CE)
+    sigs, h, pks = _fixture(n)
+    provider = tp.TpuBlsCrypto(0xA11CE, device_threshold=min(8, n))
     provider.update_pubkeys(pks)
 
-    parsed, _ = timeit("parse", lambda: dev.parse_g1_compressed(sigs))
-    prep, _ = timeit("host_prep", lambda: provider._host_prep(sigs, pks, N))
+    # Warm rep absorbs the kernel compile UNMETERED (it would dominate
+    # every stage histogram; bench_round.py does the same).
+    t0 = time.perf_counter()
+    provider.verify_batch(sigs, [h] * n, pks)
+    first_touch_s = time.perf_counter() - t0
+    say(f"{'first_touch':12s} {first_touch_s * 1e3:9.2f} ms  (compile, "
+        "unmetered)")
 
-    def round_blocked():
-        out = provider._kernels.verify_round(
-            jnp.asarray(prep[1]), jnp.asarray(prep[2]), jnp.asarray(prep[3]),
-            jnp.asarray(prep[4]), jnp.asarray(prep[5]), jnp.asarray(prep[6]),
-            *provider._pk_device())
-        jax.block_until_ready(out)
-        return out
+    metrics = Metrics()
+    prof = DeviceProfiler(metrics)
+    provider.bind_metrics(metrics)
+    provider.bind_profiler(prof)
 
-    out, _ = timeit("round", round_blocked)
-    timeit("readback", lambda: jax.device_get(out))
+    session = ProfileSession(args.profile_dir)
+    trace_dir = None
+    lat = []
+    for rep in range(args.iters):
+        capture = rep == 0 and session.available \
+            and session.start(1, label=f"verify_n{n}")
+        t0 = time.perf_counter()
+        results = provider.verify_batch(sigs, [h] * n, pks)
+        lat.append(time.perf_counter() - t0)
+        if capture:
+            trace_dir = session.stop()
+        assert all(results), "fixture signatures must all verify"
 
-    ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
-    agg_sig = tp._affine_to_oracle_g1(ax, ay, ainf)
-    agg_pk = tp._affine_to_oracle_g2(gx, gy, ginf)
-    h_pt = oracle.hash_to_g1(h, b"")
-    neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
-    timeit("pairing", lambda: oracle.multi_pairing_is_one(
-        [(agg_sig, neg_g2), (h_pt, agg_pk)]))
-    timeit("hash_to_g1", lambda: oracle.hash_to_g1(h, b""))
+    totals = prof.stage_totals()
+    stages_ms = {}
+    for stage in ("parse", "dispatch", "readback", "pairing"):
+        t = totals.get(f"verify_batch/{stage}")
+        if t:
+            stages_ms[stage] = round(t["total_s"] / t["count"] * 1e3, 3)
+            say(f"{stage:12s} {stages_ms[stage]:9.2f} ms")
+    full_s = sum(lat) / len(lat)
+    say(f"{'full':12s} {full_s * 1e3:9.2f} ms")
+    say(f"rate: {n / full_s:.0f} verifies/s")
 
-    _, full_dt = timeit("full", lambda: provider.verify_batch(
-        sigs, [h] * N, pks), iters=2)
-    print(f"rate: {N / full_dt:.0f} verifies/s", flush=True)
+    sharded = None
+    if args.sharded_probe:
+        sharded = provider.profile_sharded_stages(sigs, pks)
+        say(f"{'partial_red':12s} "
+            f"{sharded['partial_reduce_s'] * 1e3:9.2f} ms  "
+            f"({sharded['devices']} device(s))")
+        say(f"{'allgather':12s} {sharded['allgather_s'] * 1e3:9.2f} ms")
+
+    summary = prof.summary()
+    print(json.dumps({
+        "metric": "verify_stage_profile",
+        "device": platform,
+        "n": n,
+        "iters": args.iters,
+        "first_touch_ms": round(first_touch_s * 1e3, 1),
+        "full_ms": round(full_s * 1e3, 3),
+        "verifies_per_s": round(n / full_s, 1),
+        "stages_ms": stages_ms,
+        "occupancy": summary["occupancy"],
+        "devices": summary["devices"],
+        "sharded": sharded,
+        "trace_dir": trace_dir,
+    }), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -52,7 +52,9 @@ def main() -> None:
     warm_simple(warm, rungs_for(max(args.device_threshold,
                                     args.a_validators, 8)))
 
-    async def run_chain(name, net, heights, timeout):
+    async def run_chain(name, net, heights, timeout, metrics, profiler):
+        from consensus_overlord_tpu.obs import snapshot
+
         t0 = time.perf_counter()
         last = t0
         ms = []
@@ -64,6 +66,13 @@ def main() -> None:
         total = time.perf_counter() - t0
         await net.stop()
         srt = sorted(ms)
+        # Registry snapshot rides in the JSON tail the way sim/run.py's
+        # does (count/sum/total samples; full buckets stay on /metrics)
+        # so the MULTICHIP_* ledger carries batch-shape data per chain.
+        scraped = snapshot(metrics.registry)
+        obs = {k: v for k, v in scraped.items()
+               if k.split("{", 1)[0].endswith(("_count", "_sum",
+                                               "_total"))}
         return {
             "chain": name,
             "validators": len(net.nodes),
@@ -72,28 +81,42 @@ def main() -> None:
             "p50_ms": round(srt[len(srt) // 2], 1),
             "p95_ms": round(srt[-1], 1),
             "delivered": net.router.delivered,
+            "metrics": obs,
+            "profile": profiler.summary(),
         }
 
     async def run() -> None:
+        from consensus_overlord_tpu.obs import DeviceProfiler, Metrics
+
+        # One registry + profiler PER CHAIN: the two fleets share a
+        # process (and a TPU) but must not share histograms, or chain
+        # B's host-path shape would pollute chain A's device numbers.
+        metrics_a, metrics_b = Metrics(), Metrics()
+        prof_a = DeviceProfiler(metrics_a)
+        prof_b = DeviceProfiler(metrics_b)
         a = SimNetwork(
             n_validators=args.a_validators,
             block_interval_ms=args.interval_ms,
             crypto_factory=lambda i: Sm2Crypto(
                 0x3000 + 7919 * i,
                 device_threshold=args.device_threshold),
-            use_frontier=True, frontier_linger_s=0.05)
+            use_frontier=True, frontier_linger_s=0.05,
+            metrics=metrics_a, profiler=prof_a, sim_device_crypto=True)
         b = SimNetwork(
             n_validators=args.b_validators,
             block_interval_ms=args.interval_ms,
             crypto_factory=lambda i: Ed25519Crypto(
                 (0x5000 + 7919 * i).to_bytes(4, "big") * 8),
-            use_frontier=True, frontier_linger_s=0.005)
+            use_frontier=True, frontier_linger_s=0.005,
+            metrics=metrics_b, profiler=prof_b, sim_device_crypto=True)
         t0 = time.perf_counter()
         a.start(init_height=1)
         b.start(init_height=1)
         ra, rb = await asyncio.gather(
-            run_chain("sm2-device", a, args.heights, args.timeout),
-            run_chain("ed25519-host", b, args.heights, args.timeout))
+            run_chain("sm2-device", a, args.heights, args.timeout,
+                      metrics_a, prof_a),
+            run_chain("ed25519-host", b, args.heights, args.timeout,
+                      metrics_b, prof_b))
         wall = time.perf_counter() - t0
         print(json.dumps({**ra, "crypto": "sm2", "tpu": True}))
         print(json.dumps({**rb, "crypto": "ed25519", "tpu": False}))

@@ -1,7 +1,9 @@
 """Observability layer: frontier/engine/WAL metrics, the flight
 recorder, the /statusz endpoint, real gRPC status codes in the RPC
-counter, and the compile-cache satellites (model-name fingerprint,
-prune-only-default-root)."""
+counter, the compile-cache satellites (model-name fingerprint,
+prune-only-default-root), and the device-profiling layer (obs/prof.py:
+staged round profiles, occupancy gauge, ProfileSession no-op/capture
+behavior, frontier flush reasons, /debug/profile trigger)."""
 
 import asyncio
 import json
@@ -360,6 +362,189 @@ class ChokeHistGC(unittest.TestCase):
             self.assertNotIn(3, eng._choke_round_hist)
             self.assertIn(30, eng._choke_round_hist)
             await h.stop()
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# device profiling layer (obs/prof.py)
+# ---------------------------------------------------------------------------
+
+class DeviceProfiling(unittest.TestCase):
+    def test_sim_provider_populates_stage_metrics(self):
+        """A verify_batch through the simulated device path records a
+        staged profile: crypto_device_stage_seconds counts, a ring
+        record, and occupancy 1.0 (sim batches ship unpadded)."""
+        from consensus_overlord_tpu.crypto.provider import (
+            SimDeviceCrypto,
+            SimHashCrypto,
+        )
+        from consensus_overlord_tpu.obs import DeviceProfiler
+
+        m = Metrics()
+        prof = DeviceProfiler(m, capacity=8)
+        c = SimDeviceCrypto(SimHashCrypto(b"\x01" * 32))
+        c.bind_metrics(m)
+        c.bind_profiler(prof)
+        h = c.hash(b"block")
+        sigs = [c.sign(h)] * 3
+        self.assertEqual(c.verify_batch(sigs, [h] * 3, [c.pub_key] * 3),
+                         [True, True, True])
+        c.aggregate_signatures(sigs, [c.pub_key] * 3)
+        s = snapshot(m.registry)
+        self.assertEqual(
+            s["crypto_device_stage_seconds_count"
+              "{op=verify_batch,stage=dispatch}"], 1)
+        self.assertEqual(
+            s["crypto_device_stage_seconds_count"
+              "{op=aggregate,stage=dispatch}"], 1)
+        self.assertEqual(s["crypto_device_batch_occupancy"], 1.0)
+        totals = prof.stage_totals()
+        self.assertGreater(totals["verify_batch/dispatch"]["count"], 0)
+        tail = prof.tail()
+        self.assertEqual([r["op"] for r in tail],
+                         ["verify_batch", "aggregate"])
+        self.assertEqual(tail[0]["batch"], 3)
+        self.assertTrue(tail[0]["ok"])
+
+    def test_occupancy_gauge_reflects_padding(self):
+        """The occupancy gauge tracks real/padded lanes where the pad is
+        computed (TpuBlsCrypto._host_prep): 3 lanes on the 8-rung →
+        0.375, in (0, 1]."""
+        from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+        from consensus_overlord_tpu.obs import DeviceProfiler
+
+        m = Metrics()
+        prof = DeviceProfiler(m)
+        p = TpuBlsCrypto(0xFEED, device_threshold=2)
+        p.bind_metrics(m)
+        p.bind_profiler(prof)
+        h = sm3_hash(b"block")
+        sigs = [p.sign(h) for _ in range(3)]
+        call = prof.begin("verify_batch", 3)
+        p._host_prep(sigs, [p.pub_key] * 3, 3, call=call)
+        call.finish()
+        s = snapshot(m.registry)
+        self.assertAlmostEqual(s["crypto_device_batch_occupancy"], 3 / 8)
+        self.assertGreater(s["crypto_device_batch_occupancy"], 0)
+        self.assertLessEqual(s["crypto_device_batch_occupancy"], 1)
+        self.assertEqual(prof.tail()[-1]["padded"], 8)
+        # bind_profiler announced the dispatch device set.
+        self.assertEqual(s["mesh_devices"], 1)
+
+    def test_statusz_profile_section_and_debug_trigger(self):
+        """/statusz carries the "profile" section; /debug/profile is
+        loopback-gated, parses ?rounds=, and reports why a capture
+        can't start when no profile_dir is configured."""
+        from consensus_overlord_tpu.obs import DeviceProfiler, ProfileSession
+
+        m = Metrics()
+        prof = DeviceProfiler(m, capacity=4)
+        session = ProfileSession(None)
+        call = prof.begin("verify_batch", 2)
+        call.observe("dispatch", 0.001)
+        call.finish()
+        m.add_status_source(
+            "profile", lambda: {**prof.statusz(),
+                                "session": session.status()})
+        m.add_debug_handler(
+            "/debug/profile",
+            lambda q: session.request(int(q.get("rounds", "1"))))
+        port = m.start_exporter(0, addr="127.0.0.1")
+        try:
+            doc = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=5))
+            self.assertIn("profile", doc)
+            self.assertEqual(doc["profile"]["recent"][0]["op"],
+                             "verify_batch")
+            self.assertIn("crypto_device_stage_seconds", doc["profile"])
+            self.assertFalse(doc["profile"]["session"]["available"])
+            reply = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile?rounds=3",
+                timeout=5))
+            self.assertFalse(reply["ok"])
+            self.assertIn("profile_dir", reply["reason"])
+        finally:
+            m.stop_exporter()
+
+    def test_profile_session_noops_without_dir_or_jax(self):
+        """No profile_dir, or no jax.profiler: every entry point is a
+        clean no-op — start() False, on_round() silent, stop() None."""
+        from consensus_overlord_tpu.obs import prof as prof_mod
+
+        session = prof_mod.ProfileSession(None, every_n_rounds=1)
+        self.assertFalse(session.available)
+        self.assertFalse(session.start())
+        for r in range(3):
+            session.on_round(1, r)  # must not raise or capture
+        self.assertIsNone(session.stop())
+        self.assertFalse(session.request(2)["ok"])
+        # jax.profiler unavailable: configured dir changes nothing.
+        with mock.patch.object(prof_mod, "_profiler_mod", None), \
+                mock.patch.object(prof_mod, "_profiler_checked", True):
+            session = prof_mod.ProfileSession("/tmp/nowhere", 1)
+            self.assertFalse(session.available)
+            self.assertFalse(session.start())
+            session.on_round(1, 0)
+            self.assertIsNone(session.stop())
+            self.assertFalse(session.request(1)["ok"])
+            # annotate degrades to a nullcontext, not an error.
+            with prof_mod.annotate("noop"):
+                pass
+
+    def test_profile_session_round_cadence_and_capture(self):
+        """With a profile_dir: on_round opens a capture on the
+        every_n_rounds cadence and closes it a round later, leaving a
+        non-empty trace directory."""
+        from consensus_overlord_tpu.obs import ProfileSession
+
+        with tempfile.TemporaryDirectory() as tmp:
+            session = ProfileSession(tmp, every_n_rounds=2)
+            if not session.available:  # no jax.profiler in this env
+                self.skipTest("jax.profiler unavailable")
+            import jax.numpy as jnp
+
+            session.on_round(1, 0)  # round_ix 1: no capture
+            self.assertFalse(session.active)
+            session.on_round(1, 1)  # round_ix 2: capture opens
+            self.assertTrue(session.active)
+            jnp.arange(4).block_until_ready()  # something to trace
+            session.on_round(1, 2)  # budget spent: capture closes
+            self.assertFalse(session.active)
+            files = [os.path.join(r, f)
+                     for r, _, fs in os.walk(tmp) for f in fs]
+            self.assertTrue(files, "capture left no trace files")
+            self.assertIsNotNone(session.status()["last_capture_dir"])
+
+
+# ---------------------------------------------------------------------------
+# frontier flush reasons
+# ---------------------------------------------------------------------------
+
+class FrontierFlushReason(unittest.TestCase):
+    def test_linger_and_max_batch_reasons_counted(self):
+        """A size-triggered flush counts under max_batch; a timer
+        flush under linger — the queue-wait histogram's decoder ring."""
+        async def main():
+            crypto = CpuBlsCrypto(0xC0FFEE)
+            m = Metrics()
+            fr = BatchingVerifier(crypto, max_batch=2, linger_s=0.005,
+                                  metrics=m)
+            h = sm3_hash(b"payload")
+            good = crypto.sign(h)
+            # Two concurrent requests hit max_batch=2 and flush on size.
+            await asyncio.gather(
+                fr.verify(good, h, crypto.pub_key),
+                fr.verify(good, h, crypto.pub_key))
+            # A lone request can only leave via the linger timer.
+            await fr.verify(good, h, crypto.pub_key)
+            fr.close()
+            s = snapshot(m.registry)
+            self.assertEqual(
+                s["frontier_flush_reason_total{reason=max_batch}"], 1)
+            self.assertEqual(
+                s["frontier_flush_reason_total{reason=linger}"], 1)
+            self.assertNotIn(
+                "frontier_flush_reason_total{reason=shutdown}", s)
         run(main())
 
 
