@@ -16,15 +16,25 @@ measured and reported on stderr for context:
   - cpu_python: the pure-Python oracle (the round-1 strawman — kept so
     the inflation of comparing against it stays visible)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line — a self-contained perf-ledger BenchRecord
+(obs/ledger.py): {"metric", "value", "unit", "vs_baseline"} plus the
+ledger envelope (schema version, env fingerprint: device kind, jax
+version, git sha), the context rates that used to go to stderr, and the
+embedded device stage profile — so BENCH_rNN.json diffs/trends through
+scripts/ledger.py without mining log tails.
 """
 
 import json
+import logging
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Machine-clean output: the xla_bridge "Platform 'axon' is experimental"
+# WARNING otherwise lands in the recorded BENCH tail ahead of the JSON.
+logging.getLogger("jax._src.xla_bridge").setLevel(logging.ERROR)
 
 # 8192 votes/batch: large enough to amortize the ~200 ms dispatch→read
 # round-trip of the remote PJRT link (a 10k-validator round needs batches
@@ -97,6 +107,14 @@ def main():
     result = provider.verify_batch(sigs, hashes, pks)
     assert all(result), "bench batch failed verification"
 
+    # Stage profile rides the measured batches (bound AFTER the warmup
+    # so the compile doesn't dominate the dispatch stage).  No Metrics
+    # registry — DeviceProfiler's cumulative totals alone, one dict
+    # update per stage boundary, nothing on the per-lane path.
+    from consensus_overlord_tpu.obs.prof import DeviceProfiler
+    prof = DeviceProfiler()
+    provider.bind_profiler(prof)
+
     t0 = time.time()
     for _ in range(ITERS):
         result = provider.verify_batch(sigs, hashes, pks)
@@ -150,23 +168,26 @@ def main():
         t0 = time.time()
         oracle.multi_pairing_is_one_pure([(sig_pt, neg_g2), (h_pt, pk_pt)])
         pure = 1 / (time.time() - t0)
-    print(json.dumps({
-        "context": {
+
+    # ONE self-contained ledger record on stdout: the context rates that
+    # used to be a separate stderr line now live inside it, so the
+    # recorded BENCH tail is machine-clean JSON end to end.
+    from consensus_overlord_tpu.obs import ledger
+    print(json.dumps(ledger.build_record(
+        "bls12381_sig_verifies_per_sec_per_chip",
+        round(rate, 2), "verifies/s",
+        profiler=prof,
+        context={
             "batch": N, "iters": ITERS, "distinct_hashes": HASHES,
+            "depth": depth,
             "sync_verifies_per_s": round(sync_rate, 2),
             "pipelined_verifies_per_s": round(rate, 2),
             cpu_key: round(cpu_best, 2),
             "cpu_pure_python_pairings_per_s":
                 round(pure, 2) if pure else None,
             "blst_equiv_baseline_per_s": BLST_EQUIV_CPU_RATE,
-        }}), file=sys.stderr)
-
-    print(json.dumps({
-        "metric": "bls12381_sig_verifies_per_sec_per_chip",
-        "value": round(rate, 2),
-        "unit": "verifies/s",
-        "vs_baseline": round(rate / BLST_EQUIV_CPU_RATE, 2),
-    }))
+        },
+        vs_baseline=round(rate / BLST_EQUIV_CPU_RATE, 2))))
 
 
 if __name__ == "__main__":

@@ -108,6 +108,17 @@ class FileWal:
             self._metrics.wal_append_ms.observe(
                 (time.perf_counter() - t0) * 1000.0)
 
+    def size_bytes(self) -> int:
+        """On-disk size of the live WAL file (0 = never saved).  The
+        soak sampler's WAL-growth series (obs/telemetry.py): the
+        overwrite-in-place design means this should track the engine's
+        state-blob size, not grow monotonically — unbounded growth here
+        IS the finding."""
+        try:
+            return os.path.getsize(self._path)
+        except OSError:
+            return 0
+
     async def load(self) -> Optional[bytes]:
         async with self._lock:
             return await asyncio.to_thread(self._read)
@@ -176,6 +187,11 @@ class MemoryWal:
         if self._metrics is not None:
             self._metrics.wal_append_ms.observe(
                 (time.perf_counter() - t0) * 1000.0)
+
+    def size_bytes(self) -> int:
+        """Framed-blob size — the FileWal twin, so sim soaks chart the
+        same WAL-growth series a production FileWal would."""
+        return len(self.data) if self.data is not None else 0
 
     async def load(self) -> Optional[bytes]:
         if self.data is None:

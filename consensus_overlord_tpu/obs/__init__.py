@@ -19,6 +19,16 @@ src/main.rs:96, 111, 137).  Here:
                  the config-gated jax.profiler.trace wrapper behind
                  profile_dir / profile_every_n_rounds and the
                  /debug/profile?rounds=N trigger
+  ledger.py    — the perf ledger: versioned BenchRecord schema every
+                 bench/profile entry point emits (env fingerprint +
+                 embedded stage profile), plus the diff/trend/check
+                 math behind scripts/ledger.py (noise-banded deltas,
+                 plateau detection, the CI regression gate)
+  telemetry.py — TelemetrySampler: bounded time-series snapshots of the
+                 live process (WAL size, flight-recorder churn, RSS,
+                 compile-cache ratio, breaker state, occupancy) every N
+                 seconds into a ring + optional JSONL — the soak lane's
+                 drift detector and the /statusz "trend" section
   logctx.py    — logging init from LogConfig + W3C traceparent extraction
                  from gRPC metadata into contextvars, stamped onto every
                  log record (the `set_parent` analog); per-request server
@@ -46,6 +56,7 @@ _EXPORTS = {
     "DeviceProfiler": "prof",
     "ProfileSession": "prof",
     "annotate": "prof",
+    "TelemetrySampler": "telemetry",
     "JaegerExporter": "tracing",
     "Span": "tracing",
 }

@@ -44,6 +44,15 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=max(int(capacity), 1))
         self._seq = itertools.count()
         self.capacity = max(int(capacity), 1)
+        #: Lifetime events recorded / overwritten out of the ring.  The
+        #: soak sampler (obs/telemetry.py) differences `dropped` across
+        #: samples: ring churn RATE is the signal — a quiet engine whose
+        #: ring suddenly cycles every few seconds is misbehaving even if
+        #: every individual event looks routine.  (CPython int += under
+        #: the GIL is safe for the single-writer engine loop; readers
+        #: only ever see a slightly stale count.)
+        self.recorded = 0
+        self.dropped = 0
 
     def record(self, kind: str, **fields) -> None:
         """Append one event.  Hot-path cheap; never raises."""
@@ -51,7 +60,10 @@ class FlightRecorder:
             event = {"seq": next(self._seq), "ts": time.time(),
                      "kind": kind}
             event.update(fields)
+            if len(self._events) == self.capacity:
+                self.dropped += 1  # the append below evicts the oldest
             self._events.append(event)
+            self.recorded += 1
         except Exception:  # noqa: BLE001 — observability never breaks SMR
             pass
 
@@ -65,6 +77,11 @@ class FlightRecorder:
         if n is not None:
             events = events[-n:] if n > 0 else []
         return events
+
+    def stats(self) -> dict:
+        """Ring occupancy + lifetime churn counters (JSON-encodable)."""
+        return {"events": len(self._events), "capacity": self.capacity,
+                "recorded": self.recorded, "dropped": self.dropped}
 
     def clear(self) -> None:
         self._events.clear()

@@ -78,6 +78,19 @@ class ConsensusConfig:
     #: Per-call records kept in the device-profile ring (served under
     #: /statusz "profile"; bounded like the flight recorder).
     profile_ring_capacity: int = 256
+    #: Soak telemetry (obs/telemetry.py TelemetrySampler): snapshot the
+    #: process drift axes (WAL size, flight-recorder churn, RSS,
+    #: compile-cache ratio, breaker state, occupancy) every N seconds
+    #: into a bounded window served as the /statusz "trend" section.
+    #: <= 0 disables the sampler entirely.
+    telemetry_sample_every_s: float = 30.0
+    #: Optional JSONL sink for the telemetry time series (one sample
+    #: per line, size-bounded) — the long-soak post-mortem artifact.
+    #: None/"" keeps samples in memory only.
+    telemetry_jsonl_path: Optional[str] = None
+    #: Samples retained in the in-memory window (the /statusz trend
+    #: span: window * sample_every seconds of history).
+    telemetry_window: int = 512
     #: /statusz + /debug/vars answer loopback clients only unless this is
     #: set: they expose live consensus position and the flight-recorder
     #: tail, which is reconnaissance material on a routable host.

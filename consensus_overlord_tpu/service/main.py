@@ -69,6 +69,7 @@ class ServiceRuntime:
                                       lc.service_name or "consensus")
                        if lc is not None and lc.agent_endpoint else None)
         self.consensus: Optional[Consensus] = None
+        self.sampler = None
         self.health: Optional[HealthServer] = None
         self.bound_port: Optional[int] = None
         self.metrics_port: Optional[int] = None
@@ -128,6 +129,31 @@ class ServiceRuntime:
                 self.metrics.add_debug_handler(
                     "/debug/profile",
                     lambda q: session.request(int(q.get("rounds", "1"))))
+        # Soak telemetry: periodic drift snapshots (WAL size, ring
+        # churn, RSS, compile-cache ratio, breaker state) into a
+        # bounded window; /statusz "trend" serves the deltas so an
+        # operator reads drift live instead of post-mortem.  Gated on
+        # the sampling knob ALONE (the config contract: <= 0 disables)
+        # — with metrics off the counter/occupancy columns are simply
+        # absent, but the JSONL sink and ring still run.
+        if cfg.telemetry_sample_every_s > 0:
+            from ..obs import TelemetrySampler
+            from ..obs.telemetry import wal_size_bytes
+
+            wal = self.consensus.wal
+            recorder = self.recorder
+            self.sampler = TelemetrySampler(
+                metrics=self.metrics,
+                interval_s=cfg.telemetry_sample_every_s,
+                out_path=cfg.telemetry_jsonl_path,
+                window=cfg.telemetry_window,
+                wal_size_fn=lambda: wal_size_bytes(wal),
+                recorders_fn=lambda: ([recorder] if recorder else []),
+                breaker_status_fn=getattr(self.consensus.crypto,
+                                          "degraded_status", None),
+                profiler=self.consensus.profiler).start()
+            if self.metrics is not None:
+                self.metrics.add_status_source("trend", self.sampler.trend)
         interceptors = [TraceContextInterceptor(exporter=self.tracer)]
         if self.metrics is not None:
             interceptors.append(self.metrics.interceptor())
@@ -190,6 +216,9 @@ class ServiceRuntime:
             self._server = None
         if self.consensus is not None:
             await self.consensus.close()
+        if self.sampler is not None:
+            self.sampler.stop()
+            self.sampler = None
         if self.metrics is not None:
             self.metrics.stop_exporter()
         if self.tracer is not None:

@@ -185,6 +185,21 @@ def main() -> None:
                         help="with --profile-dir: capture a one-round "
                         "trace at every Nth round (0 = capture the "
                         "first round only)")
+    parser.add_argument("--soak-seconds", type=float, default=0.0,
+                        help="after the target height, keep the fleet "
+                        "committing until this much wall-clock has "
+                        "passed since start, with the telemetry "
+                        "sampler recording drift (WAL size, flightrec "
+                        "churn, RSS, occupancy) the whole way — the "
+                        "long-run lane's shape at smoke-test length")
+    parser.add_argument("--sample-every", type=float, default=10.0,
+                        help="telemetry sampling interval in seconds "
+                        "(obs/telemetry.py TelemetrySampler)")
+    parser.add_argument("--soak-out", default=None,
+                        help="JSONL path for the telemetry time series "
+                        "(default with --soak-seconds: "
+                        "soak_samples.jsonl; without it samples stay "
+                        "in the in-memory window served at /statusz)")
     parser.add_argument("--flightrec", type=int, default=256,
                         help="per-node flight-recorder capacity (events); "
                         "rings are dumped if the run times out.  0 = off")
@@ -282,7 +297,9 @@ def main() -> None:
     async def run() -> dict:
         import tempfile
 
-        from ..obs import DeviceProfiler, Metrics, ProfileSession, snapshot
+        from ..obs import (DeviceProfiler, Metrics, ProfileSession,
+                           TelemetrySampler, snapshot)
+        from ..obs.telemetry import wal_size_bytes
 
         metrics = Metrics()
         # Staged round profiles ride every run (the "profile" block in
@@ -318,6 +335,23 @@ def main() -> None:
                          # "profile" summary block — with zero hardware.
                          sim_device_crypto=True,
                          profiler=profiler)
+        # Soak telemetry: sample the fleet's drift axes on a cadence.
+        # Collectors dereference net.nodes at sample time (chaos
+        # crash-restarts swap node objects mid-run); WAL bytes sum the
+        # whole fleet so per-node growth can't hide in an average.
+        soak_out = args.soak_out
+        if soak_out is None and args.soak_seconds > 0:
+            soak_out = "soak_samples.jsonl"
+        sampler = TelemetrySampler(
+            metrics=metrics,
+            interval_s=args.sample_every,
+            out_path=soak_out,
+            wal_size_fn=lambda: sum(
+                wal_size_bytes(n.wal) or 0 for n in net.nodes),
+            recorders_fn=lambda: [n.recorder for n in net.nodes],
+            breaker_status_fn=getattr(net.nodes[0].crypto,
+                                      "degraded_status", None),
+            profiler=profiler)
         statusz_port = None
         if args.statusz_port is not None:
             # The fleet shares one registry; statusz reports node 0's
@@ -338,6 +372,9 @@ def main() -> None:
             metrics.add_status_source(
                 "profile", lambda: {**profiler.statusz(),
                                     "session": session.status()})
+            # Drift over the retained sample window — the live answer
+            # to "is anything creeping" without reading the JSONL.
+            metrics.add_status_source("trend", sampler.trend)
             metrics.add_debug_handler(
                 "/debug/profile",
                 lambda q: session.request(int(q.get("rounds", "1"))))
@@ -350,6 +387,7 @@ def main() -> None:
         net.nodes[0].engine.profile = session
         if session.available and args.profile_every_n_rounds == 0:
             session.request(1)
+        sampler.start()  # baseline sample lands before the first height
         net.start(init_height=1)
         chaos = None
         if args.chaos:
@@ -443,6 +481,15 @@ def main() -> None:
                     f"safety violations: {net.controller.violations}")
                 assert net.controller.latest_height >= args.heights
                 _assert_adversarial(metrics, chaos, snapshot, net)
+            if args.soak_seconds > 0:
+                # Soak: hold the fleet committing until the wall-clock
+                # budget (measured from fleet start) is spent, one
+                # height at a time so a wedge is still a diagnosed
+                # liveness failure, not a silent hang.
+                soak_deadline = t0 + args.soak_seconds
+                while time.perf_counter() < soak_deadline:
+                    await advance(net.controller.latest_height + 1,
+                                  " (soak)")
         except Exception:
             if args.flightrec:
                 print(net.dump_flight_recorders(64), file=sys.stderr)
@@ -452,6 +499,9 @@ def main() -> None:
                 metrics.stop_exporter()
         total = t_target - t0
         runway_s = time.perf_counter() - t_target
+        # Final sample while the fleet is still live (WAL/recorder
+        # collectors dereference nodes), then stop the cadence.
+        sampler.stop(final_sample=True)
         # stop() unregisters every node — snapshot the router while the
         # fleet is still live so registered/partition state is truthful.
         router_stats = net.router.stats()
@@ -507,6 +557,13 @@ def main() -> None:
                         "recent": profiler.tail(16),
                         "session": session.status(),
                         "trace_dir": trace_dir},
+            # Soak telemetry disposition: how many samples landed and
+            # where, plus the drift deltas over the retained window —
+            # the summary-side twin of the /statusz "trend" section.
+            "telemetry": {"samples": sampler.samples_taken,
+                          "out_path": soak_out,
+                          "soak_seconds": args.soak_seconds,
+                          "trend": sampler.trend()},
         }
         if chaos is not None:
             out["chaos"] = {
@@ -527,7 +584,11 @@ def main() -> None:
                 }
         return out
 
-    print(json.dumps(asyncio.run(run())))
+    from ..obs import ledger
+
+    # The summary line IS a ledger entry: stamp the envelope (version,
+    # ts, env fingerprint) so sim JSON tails diff/trend like BENCH_rNN.
+    print(json.dumps(ledger.annotate(asyncio.run(run()))))
 
 
 if __name__ == "__main__":

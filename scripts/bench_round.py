@@ -269,8 +269,16 @@ async def main():
         shape.update(snapshot(metrics.registry, prefix="crypto_dispatch"))
         shape.update(snapshot(metrics.registry, prefix="consensus_round"))
         shape.update(snapshot(metrics.registry, prefix="crypto_device"))
-        print(json.dumps({
+        from consensus_overlord_tpu.obs import ledger
+
+        # Ledger envelope (schema version + env fingerprint): the
+        # per-scale line lands in BENCH_* artifacts and must
+        # diff/trend like bench.py's record.
+        print(json.dumps(ledger.annotate({
             "metric": "consensus_round_p50_ms", "validators": n,
+            # Headline value/unit: the ledger's diff/check gates on
+            # these (unit "ms" marks the metric lower-is-better).
+            "value": round(pctl(lat, 0.5) * 1e3, 1), "unit": "ms",
             "rounds": ROUNDS,
             "leader_p50_ms": round(pctl(lat, 0.5) * 1e3, 1),
             "leader_p95_ms": round(pctl(lat, 0.95) * 1e3, 1),
@@ -284,7 +292,7 @@ async def main():
             # last-batch occupancy — the per-chip view of where the
             # leader's round actually went.
             "profile": {**prof.summary(), "recent": prof.tail(8)},
-        }), flush=True)
+        })), flush=True)
 
 
 if __name__ == "__main__":

@@ -151,9 +151,16 @@ def main() -> int:
             f"({sharded['devices']} device(s))")
         say(f"{'allgather':12s} {sharded['allgather_s'] * 1e3:9.2f} ms")
 
+    from consensus_overlord_tpu.obs import ledger
+
     summary = prof.summary()
-    print(json.dumps({
+    # Ledger envelope + embedded profile block: the JSON tail is a
+    # BenchRecord (value = verifies/s at this N), so profile runs
+    # diff/trend against each other and against bench.py records.
+    print(json.dumps(ledger.annotate({
         "metric": "verify_stage_profile",
+        "value": round(n / full_s, 1),
+        "unit": "verifies/s",
         "device": platform,
         "n": n,
         "iters": args.iters,
@@ -165,7 +172,7 @@ def main() -> int:
         "devices": summary["devices"],
         "sharded": sharded,
         "trace_dir": trace_dir,
-    }), flush=True)
+    }, profiler=prof)), flush=True)
     return 0
 
 

@@ -118,15 +118,23 @@ def main() -> None:
             run_chain("ed25519-host", b, args.heights, args.timeout,
                       metrics_b, prof_b))
         wall = time.perf_counter() - t0
-        print(json.dumps({**ra, "crypto": "sm2", "tpu": True}))
-        print(json.dumps({**rb, "crypto": "ed25519", "tpu": False}))
-        print(json.dumps({
+        from consensus_overlord_tpu.obs import ledger
+
+        # Every line is a ledger entry (per-chain + combined): the
+        # MULTICHIP_rNN tail self-describes like BENCH_rNN does.
+        print(json.dumps(ledger.annotate({**ra, "crypto": "sm2",
+                                          "tpu": True})))
+        print(json.dumps(ledger.annotate({**rb, "crypto": "ed25519",
+                                          "tpu": False})))
+        print(json.dumps(ledger.annotate({
             "metric": "multi-chain-mixed-curve",
+            "value": round(wall, 3),
+            "unit": "wall_s",
             "chains": 2,
             "total_validators": args.a_validators + args.b_validators,
             "heights_per_chain": args.heights,
             "wall_s": round(wall, 3),
-        }))
+        })))
 
     asyncio.run(run())
 

@@ -1,0 +1,392 @@
+"""Perf ledger + soak telemetry (obs/ledger.py, obs/telemetry.py,
+scripts/ledger.py): BenchRecord schema round-trip, legacy BENCH-wrapper
+parsing, noise-band diff classification, plateau + regression gates
+against synthetic trajectories AND the real BENCH_r01-r05 files as
+fixtures, the TelemetrySampler's sample/ring/JSONL/trend surfaces, the
+flight-recorder churn counters, the WAL size hooks, and the CLI's
+exit-code contract."""
+
+import asyncio
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import unittest
+
+from consensus_overlord_tpu.engine.wal import FileWal, MemoryWal, frame_record
+from consensus_overlord_tpu.obs import FlightRecorder, Metrics
+from consensus_overlord_tpu.obs import ledger
+from consensus_overlord_tpu.obs.telemetry import (
+    TelemetrySampler,
+    wal_size_bytes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: The real r01-r05 trajectory, committed at the repo root — the ledger
+#: must stay able to read its own history.
+BENCH_FIXTURES = sorted(glob.glob(os.path.join(REPO, "BENCH_r0[1-5].json")))
+LEDGER_CLI = os.path.join(REPO, "scripts", "ledger.py")
+
+
+def rec(run, value, unit="verifies/s", metric="throughput",
+        stages=None, occupancy=None):
+    return ledger.BenchRecord(run=run, metric=metric, value=value,
+                              unit=unit, stages=stages or {},
+                              occupancy=occupancy)
+
+
+class LedgerSchema(unittest.TestCase):
+    def test_build_record_roundtrip(self):
+        class _Prof:  # DeviceProfiler.summary() shape, no device needed
+            def summary(self):
+                return {"crypto_device_stage_seconds": {
+                            "verify_batch/dispatch":
+                                {"count": 4, "total_s": 0.8}},
+                        "occupancy": 0.875}
+
+        doc = ledger.build_record(
+            "bls_verifies_per_s", 12345.6, "verifies/s", profiler=_Prof(),
+            context={"batch": 8192}, vs_baseline=8.8)
+        self.assertEqual(doc["ledger_version"], ledger.LEDGER_VERSION)
+        self.assertIn("git_sha", doc["env"])
+        loaded = ledger.load_record(json.loads(json.dumps(doc)), run="x")
+        self.assertEqual(loaded.value, 12345.6)
+        self.assertEqual(loaded.context["batch"], 8192)
+        self.assertEqual(loaded.occupancy, 0.875)
+        self.assertAlmostEqual(
+            loaded.stage_means()["verify_batch/dispatch"], 0.2)
+        # to_dict -> from_dict closes the loop
+        again = ledger.BenchRecord.from_dict(loaded.to_dict(), run="x")
+        self.assertEqual(again.value, loaded.value)
+        self.assertEqual(again.stages, loaded.stages)
+        self.assertEqual(again.vs_baseline, 8.8)
+
+    def test_legacy_driver_wrapper_and_tail_mining(self):
+        wrapper = {
+            "n": 9, "cmd": "python bench.py", "rc": 0,
+            "tail": ("WARNING: Platform 'axon' is experimental\n"
+                     '{"context": {"batch": 4096, "iters": 2}}\n'
+                     "not json at all\n"
+                     '{"metric": "m", "value": 10.0, "unit": "u"}\n'),
+            "parsed": {"metric": "m", "value": 10.0, "unit": "u",
+                       "vs_baseline": 2.0},
+        }
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "BENCH_r09.json")
+            with open(path, "w") as f:
+                json.dump(wrapper, f)
+            loaded = ledger.load_record(path)
+        self.assertEqual(loaded.run, "r09")     # label from the filename
+        self.assertEqual(loaded.value, 10.0)
+        self.assertEqual(loaded.vs_baseline, 2.0)
+        self.assertEqual(loaded.context["batch"], 4096)  # mined from tail
+
+    def test_real_bench_fixtures_load(self):
+        self.assertEqual(len(BENCH_FIXTURES), 5, BENCH_FIXTURES)
+        records = ledger.load_records(BENCH_FIXTURES)
+        self.assertEqual([r.run for r in records],
+                         ["r01", "r02", "r03", "r04", "r05"])
+        self.assertAlmostEqual(records[0].value, 400.55)
+        self.assertAlmostEqual(records[4].value, 20808.15)
+        # r02+ tails carry the {"context": ...} stderr line
+        self.assertEqual(records[4].context.get("batch"), 8192)
+
+
+class DiffNoiseBands(unittest.TestCase):
+    def test_throughput_classification_against_band(self):
+        base = rec("a", 1000.0)
+        for value, verdict in ((1030.0, "noise"), (970.0, "noise"),
+                               (1100.0, "improved"), (900.0, "regressed")):
+            deltas = ledger.diff(base, rec("b", value),
+                                 throughput_band=0.05)
+            self.assertEqual(deltas[0].verdict, verdict,
+                             f"{value}: {deltas[0]}")
+
+    def test_latency_metric_direction_flips(self):
+        base = rec("a", 100.0, unit="ms", metric="round_p50_ms")
+        down = ledger.diff(base, rec("b", 80.0, unit="ms",
+                                     metric="round_p50_ms"))[0]
+        self.assertEqual(down.verdict, "improved")
+        up = ledger.diff(base, rec("b", 130.0, unit="ms",
+                                   metric="round_p50_ms"))[0]
+        self.assertEqual(up.verdict, "regressed")
+
+    def test_rate_units_are_not_latencies(self):
+        self.assertFalse(ledger._lower_is_better("throughput",
+                                                 "verifies/s"))
+        self.assertFalse(ledger._lower_is_better("commits_per_s", ""))
+        self.assertTrue(ledger._lower_is_better("round_p50_ms", "ms"))
+        self.assertTrue(ledger._lower_is_better("multi-chain", "wall_s"))
+
+    def test_stage_means_compared_lower_better(self):
+        stages_a = {"verify_batch/dispatch": {"count": 10, "total_s": 1.0}}
+        stages_b = {"verify_batch/dispatch": {"count": 10, "total_s": 2.0}}
+        deltas = ledger.diff(rec("a", 1.0, stages=stages_a),
+                             rec("b", 1.0, stages=stages_b),
+                             stage_band=0.25)
+        stage = [d for d in deltas if d.dimension.startswith("stage ")][0]
+        self.assertEqual(stage.verdict, "regressed")  # 2x the mean
+        self.assertFalse(stage.higher_is_better)
+
+    def test_occupancy_dimension(self):
+        deltas = ledger.diff(rec("a", 1.0, occupancy=0.9),
+                             rec("b", 1.0, occupancy=0.5))
+        occ = [d for d in deltas if d.dimension == "occupancy"][0]
+        self.assertEqual(occ.verdict, "regressed")
+
+
+class PlateauAndCheck(unittest.TestCase):
+    def test_plateau_detection_on_synthetic_trajectory(self):
+        # climb, climb, flat, flat — trailing 3-record plateau
+        records = [rec(f"r{i}", v) for i, v in
+                   enumerate([100, 150, 200, 201, 200.5])]
+        runs = ledger.plateaus(records, plateau_runs=2, plateau_band=0.01)
+        self.assertEqual(runs, [(2, 4)])
+        report = ledger.trend(records)
+        self.assertEqual(report["plateaus"],
+                         [{"from": "r2", "to": "r4", "runs": 3}])
+        self.assertTrue(report["rows"][4].get("plateau"))
+
+    def test_no_plateau_on_a_climbing_curve(self):
+        records = [rec(f"r{i}", 100.0 * (1.1 ** i)) for i in range(5)]
+        self.assertEqual(ledger.plateaus(records), [])
+
+    def test_check_fails_synthetic_ten_pct_regression(self):
+        findings = ledger.check([rec("prev", 20808.15),
+                                 rec("cur", 18727.3)])
+        fatal = [f for f in findings if f.fatal]
+        self.assertEqual([f.kind for f in fatal], ["regression"])
+
+    def test_check_passes_within_noise_and_flags_plateau(self):
+        findings = ledger.check([rec("r04", 20832.38),
+                                 rec("r05", 20808.15)])
+        self.assertFalse(any(f.fatal for f in findings))
+        self.assertEqual([f.kind for f in findings], ["plateau"])
+        # the same plateau turns fatal only on request
+        strict = ledger.check([rec("r04", 20832.38),
+                               rec("r05", 20808.15)],
+                              fail_on_plateau=True)
+        self.assertTrue(any(f.fatal and f.kind == "plateau"
+                            for f in strict))
+
+    def test_check_latency_metric_regresses_upward(self):
+        findings = ledger.check(
+            [rec("a", 100.0, unit="ms", metric="round_p50_ms"),
+             rec("b", 120.0, unit="ms", metric="round_p50_ms")])
+        self.assertTrue(any(f.kind == "regression" and f.fatal
+                            for f in findings))
+
+    def test_check_stage_blowup(self):
+        a = rec("a", 1000.0,
+                stages={"verify_batch/readback":
+                        {"count": 5, "total_s": 0.5}})
+        b = rec("b", 1000.0,
+                stages={"verify_batch/readback":
+                        {"count": 5, "total_s": 1.0}})
+        findings = ledger.check([a, b], max_stage_blowup=0.5)
+        self.assertTrue(any(f.kind == "stage_blowup" and f.fatal
+                            for f in findings))
+        # within the blowup limit: clean
+        b.stages["verify_batch/readback"]["total_s"] = 0.6
+        self.assertFalse(any(f.fatal for f in ledger.check(
+            [a, b], max_stage_blowup=0.5)))
+
+    def test_incomparable_records_flag_instead_of_gating(self):
+        # A glob that swept MULTICHIP (wall_s) and BENCH (verifies/s)
+        # together: the six-digit-percent "regression" must not exist.
+        a = rec("a", 4.2, unit="wall_s", metric="multi-chain")
+        b = rec("b", 20808.15)
+        findings = ledger.check([a, b])
+        self.assertFalse(any(f.fatal for f in findings), findings)
+        self.assertEqual(findings[0].kind, "incomparable")
+        self.assertEqual(ledger.diff(a, b), [])       # nothing compared
+        # and a metric change breaks a plateau run, not extends it
+        flat = [rec("r1", 100.0), rec("r2", 100.1),
+                rec("r3", 100.0, metric="other")]
+        self.assertEqual(ledger.plateaus(flat), [(0, 1)])
+
+    def test_real_trajectory_r04_r05_plateau_passes_gate(self):
+        records = ledger.load_records(BENCH_FIXTURES)
+        findings = ledger.check(records)
+        self.assertFalse(any(f.fatal for f in findings), findings)
+        plateau = [f for f in findings if f.kind == "plateau"]
+        self.assertEqual(len(plateau), 1, findings)
+        self.assertIn("r04", plateau[0].detail)
+        self.assertIn("r05", plateau[0].detail)
+
+
+class LedgerCLI(unittest.TestCase):
+    """scripts/ledger.py exit-code contract (stdlib-only subprocesses —
+    no jax import, so each run is interpreter-startup cheap)."""
+
+    def _run(self, *argv):
+        return subprocess.run([sys.executable, LEDGER_CLI, *argv],
+                              capture_output=True, text=True, cwd=REPO)
+
+    def test_trend_prints_trajectory_and_flags_plateau(self):
+        out = self._run("trend", *BENCH_FIXTURES)
+        self.assertEqual(out.returncode, 0, out.stderr)
+        self.assertIn("r01", out.stdout)
+        self.assertIn("PLATEAU: r04 -> r05", out.stdout)
+
+    def test_check_exit_codes(self):
+        ok = self._run("check", *BENCH_FIXTURES)
+        self.assertEqual(ok.returncode, 0, ok.stdout + ok.stderr)
+        with tempfile.TemporaryDirectory() as td:
+            synth = os.path.join(td, "BENCH_r06.json")
+            with open(synth, "w") as f:
+                json.dump({"ledger_version": 1,
+                           "metric": "bls12381_sig_verifies_per_sec"
+                                     "_per_chip",
+                           "value": 20808.15 * 0.9, "unit": "verifies/s"},
+                          f)
+            bad = self._run("check", BENCH_FIXTURES[-1], synth)
+            self.assertEqual(bad.returncode, 1, bad.stdout + bad.stderr)
+            self.assertIn("regression", bad.stdout)
+
+
+class FlightRecorderChurn(unittest.TestCase):
+    def test_dropped_counts_ring_evictions(self):
+        ring = FlightRecorder(capacity=4)
+        for i in range(6):
+            ring.record("tick", i=i)
+        self.assertEqual(len(ring), 4)
+        self.assertEqual(ring.recorded, 6)
+        self.assertEqual(ring.dropped, 2)
+        self.assertEqual(ring.stats(),
+                         {"events": 4, "capacity": 4,
+                          "recorded": 6, "dropped": 2})
+
+
+class WalSizeHook(unittest.TestCase):
+    def test_memory_wal_size_tracks_framed_blob(self):
+        wal = MemoryWal()
+        self.assertEqual(wal.size_bytes(), 0)
+        asyncio.run(wal.save(b"state-blob"))
+        self.assertEqual(wal.size_bytes(), len(frame_record(b"state-blob")))
+        self.assertEqual(wal_size_bytes(wal), wal.size_bytes())
+
+    def test_file_wal_size_tracks_disk(self):
+        with tempfile.TemporaryDirectory() as td:
+            wal = FileWal(td)
+            self.assertEqual(wal.size_bytes(), 0)
+            asyncio.run(wal.save(b"abcdef"))
+            self.assertEqual(wal.size_bytes(),
+                             len(frame_record(b"abcdef")))
+
+    def test_hookless_objects_report_none(self):
+        self.assertIsNone(wal_size_bytes(object()))
+
+
+class TelemetrySamplerTests(unittest.TestCase):
+    def _sampler(self, **kw):
+        metrics = Metrics()
+        wal = MemoryWal()
+        ring = FlightRecorder(capacity=4)
+        sampler = TelemetrySampler(
+            metrics=metrics, interval_s=60.0,
+            wal_size_fn=lambda: wal_size_bytes(wal),
+            recorders_fn=lambda: [ring],
+            breaker_status_fn=lambda: {"state": "closed"}, **kw)
+        return sampler, metrics, wal, ring
+
+    def test_sample_fields(self):
+        sampler, metrics, wal, ring = self._sampler()
+        asyncio.run(wal.save(b"x" * 100))
+        for i in range(6):
+            ring.record("e", i=i)
+        metrics.committed_heights.inc(3)
+        doc = sampler.sample_now()
+        self.assertGreater(doc["rss_bytes"], 0)
+        self.assertEqual(doc["wal_bytes"], wal.size_bytes())
+        self.assertEqual(doc["flightrec"],
+                         {"events": 4, "recorded": 6, "dropped": 2})
+        self.assertEqual(doc["breaker"]["state"], "closed")
+        self.assertIn("compile_cache", doc)
+        self.assertEqual(
+            doc["counters"]["consensus_committed_heights_total"], 3.0)
+
+    def test_ring_bounded_and_jsonl_written(self):
+        with tempfile.TemporaryDirectory() as td:
+            out = os.path.join(td, "soak.jsonl")
+            sampler, _, _, _ = self._sampler(window=4, out_path=out)
+            for _ in range(6):
+                sampler.sample_now()
+            self.assertEqual(len(sampler.tail()), 4)       # ring bound
+            self.assertEqual(sampler.samples_taken, 6)
+            with open(out) as f:
+                lines = [json.loads(line) for line in f]
+            self.assertEqual(len(lines), 6)                # all landed
+            self.assertEqual(lines[0]["seq"], 1)
+
+    def test_jsonl_file_bounded_by_rewrite(self):
+        with tempfile.TemporaryDirectory() as td:
+            out = os.path.join(td, "soak.jsonl")
+            sampler, _, _, _ = self._sampler(window=3, out_path=out,
+                                             max_file_samples=4)
+            for _ in range(7):
+                sampler.sample_now()
+            with open(out) as f:
+                lines = [json.loads(line) for line in f]
+            # capped: rewritten from the 3-sample ring at overflow, then
+            # appends resume — never back above the cap + window
+            self.assertLessEqual(len(lines), 4 + 3)
+            self.assertEqual(lines[-1]["seq"], 7)  # newest survives
+
+    def test_trend_deltas_over_window(self):
+        sampler, metrics, wal, ring = self._sampler()
+        sampler.sample_now()
+        asyncio.run(wal.save(b"y" * 500))
+        for i in range(10):
+            ring.record("e", i=i)
+        metrics.committed_heights.inc(5)
+        sampler.sample_now()
+        trend = sampler.trend()
+        self.assertEqual(trend["samples"], 2)
+        self.assertEqual(trend["wal_delta_bytes"], wal.size_bytes())
+        self.assertEqual(trend["flightrec_recorded_delta"], 10)
+        self.assertEqual(trend["flightrec_dropped_delta"], 6)
+        self.assertIn("consensus_committed_heights_total_per_s",
+                      trend["counter_rates"])
+        self.assertIn("last", trend)
+
+    def test_background_thread_and_statusz_trend_section(self):
+        sampler, metrics, _, _ = self._sampler()
+        sampler.interval_s = 0.05
+        metrics.add_status_source("trend", sampler.trend)
+        sampler.start()
+        try:
+            import time
+            time.sleep(0.12)
+        finally:
+            sampler.stop()
+        # immediate baseline + >=1 periodic + final stop() sample
+        self.assertGreaterEqual(sampler.samples_taken, 3)
+        doc = metrics.statusz()
+        self.assertGreaterEqual(doc["trend"]["samples"], 1)
+        self.assertIn("rss_delta_bytes", doc["trend"])
+        # stop() is idempotent and start() restarts cleanly
+        sampler.stop()
+
+    def test_occupancy_omitted_until_first_batch(self):
+        sampler, metrics, _, _ = self._sampler()
+        # never-set gauge (initial 0.0) must not fabricate a reading
+        self.assertNotIn("occupancy", sampler.sample_now())
+        metrics.device_batch_occupancy.set(0.875)
+        self.assertEqual(sampler.sample_now()["occupancy"], 0.875)
+
+    def test_sampler_never_raises_on_broken_collectors(self):
+        sampler = TelemetrySampler(
+            wal_size_fn=lambda: 1 / 0,
+            recorders_fn=lambda: 1 / 0,
+            breaker_status_fn=lambda: 1 / 0)
+        doc = sampler.sample_now()  # collectors explode, sample survives
+        self.assertNotIn("wal_bytes", doc)
+        self.assertNotIn("flightrec", doc)
+        self.assertIn("ts", doc)
+
+
+if __name__ == "__main__":
+    unittest.main()
