@@ -16,286 +16,101 @@ Messages whose signatures fail are dropped at the frontier (the engine
 then runs with ``inbound_verified=True`` and skips per-message verifies);
 malformed input degrades to a False result, never an exception — the
 log-and-drop posture of src/consensus.rs:220-260.
+
+Since the multi-tenant refactor (crypto/tenancy.py) the batching core is
+``SharedFrontier`` and ``BatchingVerifier`` is its single-tenant shape: a
+``TenantLane`` over a core it owns.  Two consequences for the classic
+single-engine path:
+
+  * outstanding work is now BOUNDED (``max_pending`` counts queued AND
+    composed-but-unresolved requests): a stalled device no longer
+    accumulates verifies without limit — overflow sheds to the
+    provider's host-oracle ``verify_signature`` (the PR 2 breaker
+    fallback twin) with exact verdicts, counted in
+    ``frontier_admission_sheds_total{tenant="default"}``;
+  * proposal verifies ride the critical priority class and drain before
+    gossip within each flush (``priority_lanes=False`` restores strict
+    FIFO).
+
+``signature_claims`` and ``FrontierStats`` live in crypto/tenancy.py now
+and are re-exported here for compatibility.
 """
 
 from __future__ import annotations
 
-import asyncio
-import logging
-import time
-from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from .tenancy import (  # noqa: F401 — compatibility re-exports
+    DEFAULT_QUEUE_BOUND,
+    FrontierStats,
+    SharedFrontier,
+    TenantLane,
+    TenantStats,
+    signature_claims,
+)
 
-from ..core.sm3 import sm3_hash
-from ..core.types import SignedChoke, SignedProposal, SignedVote
-from ..obs.prof import annotate
-
-logger = logging.getLogger("consensus_overlord_tpu.frontier")
-
-
-def signature_claims(msg) -> Optional[Tuple[bytes, bytes, bytes]]:
-    """(signature, hash32, voter) claimed by an inbound consensus message,
-    or None for message types verified elsewhere (QCs carry aggregated
-    signatures checked in the engine against the voter bitmap)."""
-    if isinstance(msg, SignedProposal):
-        return (msg.signature, sm3_hash(msg.proposal.encode()),
-                msg.proposal.proposer)
-    if isinstance(msg, SignedVote):
-        return msg.signature, sm3_hash(msg.vote.encode()), msg.voter
-    if isinstance(msg, SignedChoke):
-        return msg.signature, sm3_hash(msg.choke.encode()), msg.address
-    return None
+__all__ = [
+    "BatchingVerifier",
+    "DEFAULT_QUEUE_BOUND",
+    "FrontierStats",
+    "SharedFrontier",
+    "TenantLane",
+    "TenantStats",
+    "signature_claims",
+]
 
 
-@dataclass
-class FrontierStats:
-    requests: int = 0
-    batches: int = 0
-    max_batch: int = 0
-    failures: int = 0
-
-    @property
-    def mean_batch(self) -> float:
-        return self.requests / self.batches if self.batches else 0.0
-
-
-class BatchingVerifier:
+class BatchingVerifier(TenantLane):
     """Coalesces `verify(sig, hash, voter)` awaitables into provider
-    `verify_batch` calls.
+    `verify_batch` calls — the single-tenant lane over a SharedFrontier
+    core this instance owns (and closes).
 
     linger_s: how long the first request of a batch waits for company.
     max_batch: flush immediately at this size (matches the provider's
     padded batch ladder so device kernels stay shape-stable).
+    max_pending: outstanding-work bound (queued + composed-but-
+    unresolved); arrivals over it shed to the provider's host-oracle
+    verify with exact verdicts (a stalled device degrades throughput,
+    never correctness or memory).
     metrics: optional obs.Metrics — every flush observes batch size,
     per-request queue wait, padded-batch occupancy, and dispatch/resolve
     phase latency; failures count by message type.  None = no overhead.
     """
 
     def __init__(self, provider, max_batch: int = 1024,
-                 linger_s: float = 0.002, metrics=None):
-        self._provider = provider
-        self._max_batch = max_batch
-        self._linger = linger_s
-        self._metrics = metrics
-        #: (sig, hash32, voter, future, msg_type, enqueue_ts)
-        self._pending: List[Tuple] = []
-        self._flush_task: Optional[asyncio.Task] = None
-        # asyncio holds only weak refs to tasks; in-flight batch tasks must
-        # be pinned or GC can collect one mid-verify, hanging every waiter.
-        self._inflight: set = set()
-        # One dedicated dispatch worker: device dispatches (which may
-        # block on a cold jit compile — minutes for a new batch shape —
-        # or on H2D transfers over a remote PJRT link) run OFF the event
-        # loop, and the single worker keeps dispatch order FIFO across
-        # flushes so pipelining stays deterministic.
-        self._dispatcher = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="frontier-dispatch")
-        self.stats = FrontierStats()
+                 linger_s: float = 0.002, metrics=None,
+                 max_pending: int = DEFAULT_QUEUE_BOUND,
+                 tenant_id: str = "default", weight: int = 1,
+                 priority_lanes: bool = True):
+        if max_pending < max_batch:
+            # The config layer rejects this too; direct constructions
+            # (bench scripts, sim harness) must hit the same wall.  A
+            # multi-tenant lane MAY be bounded below the shared
+            # max_batch (batches compose across tenants), but this
+            # lane is the core's only tenant: a bound below one batch
+            # sheds traffic a single flush could have carried, and the
+            # size-flush trigger could never fire.
+            raise ValueError(
+                f"max_pending ({max_pending}) must be >= max_batch "
+                f"({max_batch}) for a single-tenant frontier")
+        core = SharedFrontier(provider, max_batch=max_batch,
+                              linger_s=linger_s, metrics=metrics)
+        super().__init__(core, tenant_id, weight=weight,
+                         queue_bound=max_pending,
+                         priority_lanes=priority_lanes)
+        core.adopt(self)
 
-    async def verify(self, signature: bytes, hash32: bytes,
-                     voter: bytes, msg_type: str = "raw") -> bool:
-        fut = asyncio.get_running_loop().create_future()
-        self._pending.append((bytes(signature), bytes(hash32), bytes(voter),
-                              fut, msg_type, time.perf_counter()))
-        self.stats.requests += 1
-        if len(self._pending) >= self._max_batch:
-            self._flush_now("max_batch")
-        elif self._flush_task is None or self._flush_task.done():
-            self._flush_task = asyncio.get_running_loop().create_task(
-                self._linger_then_flush())
-        return await fut
+    @property
+    def stats(self) -> FrontierStats:
+        """The legacy whole-frontier counters (requests / batches /
+        mean_batch / max_batch / failures) — what /statusz "frontier"
+        and the bench scripts read.  Per-tenant counters (sheds, queue
+        waits) live on ``tenant_stats``."""
+        return self._core.stats
 
-    async def verify_msg(self, msg) -> bool:
-        """Verify a decoded consensus message's signature claim; True for
-        message types with no frontier-checkable signature."""
-        claims = signature_claims(msg)
-        if claims is None:
-            return True
-        return await self.verify(*claims, msg_type=type(msg).__name__)
-
-    async def verify_aggregated(self, agg_sig: bytes, hash32: bytes,
-                                voters) -> bool:
-        """QC aggregate verification off the event loop: dispatch through
-        the same single ordered worker as batch flushes (device FIFO
-        stays intact), block only in a resolver thread.  The engine
-        awaits this from _verify_qc so a ≥1024-voter QC check never
-        stalls consensus timers on a ~200 ms device round-trip."""
-        dispatch = getattr(self._provider, "verify_aggregated_async", None)
-        try:
-            if dispatch is None:
-                return await asyncio.to_thread(
-                    self._provider.verify_aggregated_signature,
-                    agg_sig, hash32, voters)
-            return await self._via_dispatcher(dispatch, agg_sig, hash32,
-                                              voters)
-        except Exception:  # noqa: BLE001 — malformed input is never fatal
-            logger.exception("frontier QC verification errored")
-            return False
-
-    async def aggregate(self, signatures, voters) -> bytes:
-        """QC signature aggregation off the event loop (leader path).
-        Raises CryptoError on invalid input, like the sync form."""
-        dispatch = getattr(self._provider, "aggregate_signatures_async",
-                           None)
-        if dispatch is None:
-            return await asyncio.to_thread(
-                self._provider.aggregate_signatures, signatures, voters)
-        return await self._via_dispatcher(dispatch, signatures, voters)
-
-    async def _via_dispatcher(self, dispatch, *args):
-        """dispatch(*args) on the ordered worker → resolve() in a second
-        thread (overlaps the dispatch→readback round-trip with device
-        compute, same pipeline as _run_batch)."""
-        loop = asyncio.get_running_loop()
-        resolver = await loop.run_in_executor(self._dispatcher, dispatch,
-                                              *args)
-        return await asyncio.to_thread(resolver)
+    @property
+    def core(self) -> SharedFrontier:
+        return self._core
 
     def close(self) -> None:
-        """Release the dispatch worker thread (engine/sim teardown).
-        Still-pending requests are flushed first (reason="shutdown") so
-        their futures resolve instead of hanging their awaiters — only
-        possible from a running event loop (the normal teardown path).
-        The worker shuts down only after in-flight batch tasks (incl. a
-        shutdown flush) have dispatched through it — shutting it down
-        eagerly would bounce those batches onto the per-signature host
-        re-verify fallback (RuntimeError from run_in_executor)."""
-        try:
-            loop = asyncio.get_running_loop()
-        except RuntimeError:  # no loop: nothing can await those futures
-            loop = None
-            self._pending = []
-        if self._pending:
-            self._flush_now("shutdown")
-        if loop is not None and self._inflight:
-            dispatcher = self._dispatcher
-
-            async def _drain_then_release(tasks):
-                try:
-                    await asyncio.gather(*tasks, return_exceptions=True)
-                finally:
-                    # Loop teardown can cancel this task mid-gather; the
-                    # worker thread must be released regardless or each
-                    # closed frontier leaks one non-daemon thread.
-                    dispatcher.shutdown(wait=False)
-
-            # Pinned in _inflight: asyncio holds only weak task refs
-            # (see __init__) — an unpinned drain task can be GC'd
-            # mid-await, leaking the worker thread.
-            task = loop.create_task(_drain_then_release(
-                list(self._inflight)))
-            self._inflight.add(task)
-            task.add_done_callback(self._inflight.discard)
-        else:
-            self._dispatcher.shutdown(wait=False)
-
-    async def _linger_then_flush(self) -> None:
-        await asyncio.sleep(self._linger)
-        self._flush_now("linger")
-
-    def _flush_now(self, reason: str) -> None:
-        batch, self._pending = self._pending, []
-        if not batch:
-            return
-        if self._metrics is not None:
-            # Why the batch left the frontier: linger-expired vs
-            # max-batch vs shutdown drain — without this the queue-wait
-            # histogram is uninterpretable (a long wait is EXPECTED
-            # under linger flushes, a red flag under max-batch ones).
-            self._metrics.frontier_flush_reason.labels(reason=reason).inc()
-        if self._flush_task is not None and not self._flush_task.done():
-            self._flush_task.cancel()
-        self._flush_task = None
-        task = asyncio.get_running_loop().create_task(self._run_batch(batch))
-        self._inflight.add(task)
-        task.add_done_callback(self._inflight.discard)
-
-    async def _run_batch(self, batch) -> None:
-        sigs = [b[0] for b in batch]
-        hashes = [b[1] for b in batch]
-        voters = [b[2] for b in batch]
-        m = self._metrics
-        if m is not None:
-            # Batch size only; padded-rung occupancy is observed by the
-            # provider at host-prep time (crypto/tpu_provider.py), where
-            # the pad sizes are actually computed — one source of truth
-            # across the fused/split dispatch plans.
-            m.frontier_batch_size.observe(len(batch))
-        try:
-            verify_async = getattr(self._provider, "verify_batch_async",
-                                   None)
-            if verify_async is not None:
-                # Dispatch through the single ordered worker (off-loop:
-                # a cold compile or H2D transfer never stalls consensus
-                # timers), then block only for the readback in a second
-                # thread — consecutive flushes overlap the ~200 ms
-                # dispatch→readback round-trip of a remote PJRT link
-                # with device compute.
-                loop = asyncio.get_running_loop()
-                t0 = time.perf_counter()
-                with annotate("frontier.flush"):
-                    resolver = await loop.run_in_executor(
-                        self._dispatcher, verify_async, sigs, hashes,
-                        voters)
-                t1 = time.perf_counter()
-                results = await asyncio.to_thread(resolver)
-                if m is not None:
-                    # frontier_* phases are wrappers AROUND the provider's
-                    # prep/dispatch/readback/pairing phases (they include
-                    # executor queueing), distinct labels so the series
-                    # compose instead of double-counting.
-                    t2 = time.perf_counter()
-                    m.crypto_dispatch_ms.labels(
-                        phase="frontier_dispatch").observe(
-                        (t1 - t0) * 1000.0)
-                    m.crypto_dispatch_ms.labels(
-                        phase="frontier_resolve").observe(
-                        (t2 - t1) * 1000.0)
-            else:
-                # Device dispatch blocks; keep the event loop live.
-                t0 = time.perf_counter()
-                results = await asyncio.to_thread(
-                    self._provider.verify_batch, sigs, hashes, voters)
-                if m is not None:
-                    m.crypto_dispatch_ms.labels(
-                        phase="frontier_resolve").observe(
-                        (time.perf_counter() - t0) * 1000.0)
-            errored = False
-        except Exception:  # noqa: BLE001 — malformed input is never fatal
-            # A provider whose device path died mid-batch (and that has
-            # no internal breaker/fallback of its own): re-verify every
-            # lane on the host oracle — consensus keeps making progress
-            # on exact verdicts instead of dropping a whole batch of
-            # honest votes as if they were forged.
-            logger.exception(
-                "frontier batch verification errored; host re-verify")
-            if m is not None:
-                m.host_fallbacks.labels(path="frontier_reverify").inc()
-            try:
-                results = await asyncio.to_thread(
-                    lambda: [self._provider.verify_signature(s, h, v)
-                             for s, h, v in zip(sigs, hashes, voters)])
-                errored = False
-            except Exception:  # noqa: BLE001 — even the oracle failed
-                logger.exception("frontier host re-verify errored")
-                results = [False] * len(batch)
-                errored = True
-                if m is not None:
-                    # One event under its own label: an infra error must
-                    # not masquerade as a per-message signature attack.
-                    m.frontier_verify_failures.labels(
-                        msg_type="batch_error").inc()
-        self.stats.batches += 1
-        self.stats.max_batch = max(self.stats.max_batch, len(batch))
-        now = time.perf_counter()
-        for (_, _, _, fut, msg_type, t_enq), ok in zip(batch, results):
-            if not ok:
-                self.stats.failures += 1
-                if m is not None and not errored:
-                    m.frontier_verify_failures.labels(
-                        msg_type=msg_type).inc()
-            if m is not None:
-                m.frontier_queue_wait_ms.observe((now - t_enq) * 1000.0)
-            if not fut.done():
-                fut.set_result(bool(ok))
+        """This lane owns its core: release the dispatch worker thread
+        (engine/sim teardown), draining pending requests first."""
+        self._core.close()

@@ -23,6 +23,13 @@ multiple nodes can live in one test process):
              frontier_flush_reason_total{reason} — why each batch left
              the frontier (linger expired vs max-batch hit vs shutdown
              drain), the key to reading the queue-wait histogram
+  tenancy    frontier_admission_sheds_total{tenant} — requests shed to
+             the host oracle at a full tenant queue (exact verdicts),
+             frontier_tenant_queue_wait_ms{tenant,lane} — per-tenant
+             queue wait split critical/gossip,
+             frontier_tenant_lanes_total{tenant} /
+             frontier_tenant_share{tenant} — each tenant's share of the
+             composed device batches (crypto/tenancy.py SharedFrontier)
   device     crypto_dispatch_ms{phase} — host-side phase split:
              prep (parse/pad/RLC draw), dispatch (kernel enqueue),
              readback (device round-trip), pairing (host pairing check)
@@ -148,6 +155,31 @@ class Metrics:
             "window expired, max_batch = the batch hit its size cap, "
             "shutdown = close() drained the pending queue)",
             ["reason"], registry=self.registry)
+
+        # -- multi-tenant frontier (crypto/tenancy.py) --------------------
+        self.frontier_admission_sheds = Counter(
+            "frontier_admission_sheds_total",
+            "Verify requests shed to the host-oracle path because the "
+            "tenant's pending queue hit its bound (exact verdicts — "
+            "shedding costs device batching, never correctness)",
+            ["tenant"], registry=self.registry)
+        self.frontier_tenant_queue_wait_ms = Histogram(
+            "frontier_tenant_queue_wait_ms",
+            "Per-tenant frontier queue wait, split by priority class "
+            "(lane=critical: proposal-path verifies, drained first; "
+            "lane=gossip: vote/choke verifies)",
+            ["tenant", "lane"], buckets=DEVICE_BUCKETS,
+            registry=self.registry)
+        self.frontier_tenant_lanes = Counter(
+            "frontier_tenant_lanes_total",
+            "Device-batch lanes filled by each tenant's requests (the "
+            "tenant's cumulative occupancy share of the chip)",
+            ["tenant"], registry=self.registry)
+        self.frontier_tenant_share = Gauge(
+            "frontier_tenant_share",
+            "Tenant's fraction of the last composed device batch "
+            "(DWRR fairness at a glance; compare against weights)",
+            ["tenant"], registry=self.registry)
 
         # -- device dispatch (crypto/tpu_provider.py + frontier) ----------
         self.crypto_dispatch_ms = Histogram(

@@ -52,6 +52,22 @@ class ConsensusConfig:
     crypto_backend: str = "tpu"          # "tpu" | "cpu"
     frontier_max_batch: int = 1024
     frontier_linger_ms: float = 2.0
+    #: Frontier pending-queue bound (crypto/tenancy.py): verify
+    #: requests arriving while this many are already queued shed to the
+    #: provider's host-oracle verify path (exact verdicts, counted in
+    #: frontier_admission_sheds_total) instead of growing the queue
+    #: without limit under a stalled device.  Sized generously (8× the
+    #: default max_batch) so a healthy device never sheds.
+    frontier_max_pending: int = 8192
+    #: Multi-tenant frontier knobs (crypto/tenancy.py SharedFrontier).
+    #: The defaults reproduce single-tenant behavior exactly: one
+    #: tenant ("default") at weight 1 owns every composed batch, the
+    #: queue bound inherits frontier_max_pending (tenant_queue_bound=0
+    #: means "inherit"), and priority lanes only reorder WITHIN this
+    #: node's own traffic (proposals before votes in one flush).
+    tenant_weight: int = 1
+    tenant_queue_bound: int = 0
+    tenant_priority_lanes: bool = True
     #: Engine flight recorder (obs/flightrec.py): ring capacity in
     #: events; 0 disables recording entirely.
     flight_recorder_capacity: int = 512
@@ -101,6 +117,46 @@ class ConsensusConfig:
     #: mesh's cita_cloud_proto package names (src/main.rs:64-73) so this
     #: node can register with a reference network/controller pair.
     proto_compat: str = "native"         # "native" | "cita_cloud"
+
+    def __post_init__(self) -> None:
+        """Validate the frontier/tenancy knobs at construction — a bad
+        value should fail the process at config load, not deadlock the
+        frontier at the first saturated flush."""
+        if self.frontier_max_batch < 1:
+            raise ValueError(
+                f"frontier_max_batch must be >= 1, got "
+                f"{self.frontier_max_batch}")
+        if self.frontier_linger_ms < 0:
+            raise ValueError(
+                f"frontier_linger_ms must be >= 0, got "
+                f"{self.frontier_linger_ms}")
+        if self.frontier_max_pending < self.frontier_max_batch:
+            raise ValueError(
+                f"frontier_max_pending ({self.frontier_max_pending}) must "
+                f"be >= frontier_max_batch ({self.frontier_max_batch}) — "
+                "a bound below one batch sheds traffic a single flush "
+                "could have carried")
+        if self.tenant_weight < 1:
+            raise ValueError(
+                f"tenant_weight must be >= 1, got {self.tenant_weight}")
+        if self.tenant_queue_bound < 0:
+            raise ValueError(
+                f"tenant_queue_bound must be >= 0 (0 inherits "
+                f"frontier_max_pending), got {self.tenant_queue_bound}")
+        if 0 < self.tenant_queue_bound < self.frontier_max_batch:
+            # Same degenerate state the frontier_max_pending check
+            # rejects: this knob OVERRIDES it as the effective bound.
+            raise ValueError(
+                f"tenant_queue_bound ({self.tenant_queue_bound}) must be "
+                f">= frontier_max_batch ({self.frontier_max_batch}) — a "
+                "bound below one batch sheds traffic a single flush "
+                "could have carried")
+
+    @property
+    def effective_tenant_queue_bound(self) -> int:
+        """The per-tenant bound actually applied: tenant_queue_bound,
+        or frontier_max_pending when left at 0 ("inherit")."""
+        return self.tenant_queue_bound or self.frontier_max_pending
 
     @classmethod
     def load(cls, path: str,

@@ -95,7 +95,10 @@ class Consensus:
         # whether a frontier actually guards the injection path.
         self.frontier = BatchingVerifier(
             self.crypto, max_batch=config.frontier_max_batch,
-            linger_s=config.frontier_linger_ms / 1000.0, metrics=metrics)
+            linger_s=config.frontier_linger_ms / 1000.0, metrics=metrics,
+            max_pending=config.effective_tenant_queue_bound,
+            weight=config.tenant_weight,
+            priority_lanes=config.tenant_priority_lanes)
         bind = getattr(self.crypto, "bind_metrics", None)
         if bind is not None and metrics is not None:
             bind(metrics)

@@ -111,7 +111,14 @@ class ServiceRuntime:
                     "mean_batch": frontier.stats.mean_batch,
                     "max_batch": frontier.stats.max_batch,
                     "failures": frontier.stats.failures,
+                    "sheds": frontier.stats.sheds,
                 })
+            # Per-tenant frontier view (crypto/tenancy.py): queue depth,
+            # sheds, occupancy share, p50 queue waits by priority class.
+            # One section even single-tenant — the "default" entry is
+            # where the bounded-queue shed counters live.
+            self.metrics.add_status_source(
+                "tenants", frontier.tenants_status)
             if self.recorder is not None:
                 recorder = self.recorder
                 tail_n = cfg.statusz_tail

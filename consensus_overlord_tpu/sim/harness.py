@@ -73,7 +73,8 @@ class SimNode:
                  controller: SimController, wal: Optional[Wal] = None,
                  use_frontier: bool = False, frontier_max_batch: int = 1024,
                  frontier_linger_s: float = 0.002, metrics=None,
-                 recorder=None, node_seed: int = 0, profiler=None):
+                 recorder=None, node_seed: int = 0, profiler=None,
+                 frontier_factory=None):
         from ..crypto.frontier import BatchingVerifier
         from .adversary import AdversaryShim
 
@@ -85,9 +86,19 @@ class SimNode:
         #: behavior, so any validator can turn coat mid-run.
         self.adversary = AdversaryShim(self.adapter, crypto, router,
                                        seed=node_seed, recorder=recorder)
-        self.frontier = (BatchingVerifier(crypto, frontier_max_batch,
-                                          frontier_linger_s, metrics=metrics)
-                         if use_frontier else None)
+        #: frontier_factory(crypto) -> frontier-shaped object lets a
+        #: fleet feed a SHARED multi-tenant core (crypto/tenancy.py
+        #: TenantLane — one tenant per chain) instead of a private
+        #: per-node BatchingVerifier.  A shared lane's close() is a
+        #: no-op, so node teardown never tears the core out from under
+        #: other tenants; the harness owner closes the core.
+        if frontier_factory is not None:
+            self.frontier = frontier_factory(crypto)
+        else:
+            self.frontier = (BatchingVerifier(crypto, frontier_max_batch,
+                                              frontier_linger_s,
+                                              metrics=metrics)
+                             if use_frontier else None)
         self.recorder = recorder
         if metrics is not None:
             bind = getattr(crypto, "bind_metrics", None)
@@ -166,7 +177,7 @@ class SimNetwork:
                  flight_recorder_capacity: int = 0, wal_factory=None,
                  sim_device_crypto: bool = False,
                  device_breaker_cooldown_s: float = 0.25,
-                 profiler=None):
+                 profiler=None, frontier_factory=None):
         """metrics: one shared obs.Metrics for the whole fleet (histograms
         aggregate across nodes — fine for sim-level batch/round shape).
         profiler: one shared obs.prof.DeviceProfiler — providers with a
@@ -214,6 +225,7 @@ class SimNetwork:
         self.profiler = profiler
         self._use_frontier = use_frontier
         self._frontier_linger_s = frontier_linger_s
+        self._frontier_factory = frontier_factory
         self._wal_factory = wal_factory
         self.nodes = [SimNode(c, self.router, self.controller,
                               wal=(wal_factory(i) if wal_factory is not None
@@ -225,7 +237,8 @@ class SimNetwork:
                                   flight_recorder_capacity)
                                   if flight_recorder_capacity > 0 else None),
                               node_seed=seed ^ (0x9E3779B9 * (i + 1)),
-                              profiler=profiler)
+                              profiler=profiler,
+                              frontier_factory=frontier_factory)
                       for i, c in enumerate(cryptos)]
         self.controller.on_new_height.append(self._push_status)
 
@@ -276,7 +289,8 @@ class SimNetwork:
                        frontier_linger_s=self._frontier_linger_s,
                        metrics=self.metrics, recorder=old.recorder,
                        node_seed=old.adversary.seed,
-                       profiler=self.profiler)
+                       profiler=self.profiler,
+                       frontier_factory=self._frontier_factory)
         # Adversary tallies span the crash like the flight recorder does
         # (run assertions read them after the schedule has played out).
         node.adversary.behavior_stats = old.adversary.behavior_stats

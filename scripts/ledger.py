@@ -96,6 +96,16 @@ def cmd_trend(args) -> int:
 
 def cmd_check(args) -> int:
     records = ledger.load_records(args.files)
+    if len(records) == 1:
+        # A lone record has no previous run to regress against: the
+        # gate degrades to schema validation (load_record already
+        # raised on garbage) and passes as a baseline — the shape CI
+        # needs to gate freshly-minted per-tenant artifacts.
+        cur = records[0]
+        print(f"ok (baseline): {cur.run} is the first record "
+              f"({cur.metric} = {_fmt(cur.value)} {cur.unit}) — "
+              "nothing to compare against yet")
+        return 0
     findings = ledger.check(
         records,
         max_regression=args.max_regression / 100.0,

@@ -1,20 +1,36 @@
-"""Config-5 exercise (BASELINE.json): mixed-curve multi-chain — two
-independent consensus fleets with DIFFERENT signature schemes running
-concurrently in one process, sharing one TPU through their providers'
-frontiers (the multi-chain shape CITA-Cloud deployments run, one
-consensus service per chain; reference SURVEY.md §0).
+"""Multi-tenant crypto-as-a-service acceptance harness: M chains × N
+validators in one process, every chain's signature traffic feeding ONE
+SharedFrontier (crypto/tenancy.py) — the "one TPU serving many chains"
+economics (ROADMAP "Crypto-as-a-service"), with one tenant deliberately
+saturating its lane.
 
-Chain A: SM2 validators with the device-batched provider (the scheme
-CITA-Cloud mainnets actually deploy).  Chain B: Ed25519 validators on
-the host path (its device dispatch costs ~0.8 s/batch, so below
-~64-lane coalesced batches the host C backend wins — that crossover is
-the provider's own device_threshold default, and honesty beats forcing
-traffic onto the chip).
+Each chain is an independent SimNetwork fleet registered as one tenant
+on the shared core (tenant = chain, so all N validators of a chain feed
+one lane).  The shared provider is throttled (--flush-cost-ms sleeps per
+batch) so device occupancy is contended like a real chip under load.
+Saturating tenants run a flood task that pumps verify traffic far past
+their queue bound — admission control sheds the overflow to the
+host-oracle path (exact verdicts) while DWRR keeps composing fair
+batches for the light tenants.
 
-Prints one JSON line per chain plus a combined line.
+The run is the acceptance test; it exits nonzero unless:
 
-Usage: python scripts/sim_multichain.py [--a-validators 32]
-       [--b-validators 64] [--heights 3] [--interval-ms 3000]
+  1. every chain reaches --heights (liveness under a saturating
+     neighbor — the whole point of fairness + admission control);
+  2. every saturating tenant shed at least once
+     (frontier_admission_sheds_total nonzero — the bound engaged);
+  3. no light tenant's p50 queue wait exceeds --wait-ratio × the
+     lightest light tenant's (starvation bound).
+
+Output: one ledger-stamped BenchRecord line PER TENANT (tenant id in
+the emitter context, so `scripts/ledger.py trend` can track per-tenant
+throughput across PRs) plus one combined line carrying the per-tenant
+status map, the shared-frontier stats, and the assertion outcomes.
+--out-dir additionally writes each line to its own JSON file (the CI
+artifact shape the nightly multichain-smoke job uploads).
+
+Usage: python scripts/sim_multichain.py --chains 3 --saturate 1
+       [--validators 4] [--heights 3] [--interval-ms 100] ...
 """
 
 import argparse
@@ -28,115 +44,247 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 
+class ThrottledProvider:
+    """The shared 'device': a sim-grade provider whose verify_batch
+    costs a fixed wall-clock sleep per flush — contention for the chip
+    is real even on CPU, so tenant queue dynamics (linger, sheds, DWRR
+    shares) behave like a loaded device instead of resolving in µs."""
+
+    def __init__(self, base, flush_cost_s: float):
+        self._base = base
+        self._cost = flush_cost_s
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+    def verify_batch(self, sigs, hashes, voters):
+        if self._cost > 0:
+            time.sleep(self._cost)
+        return self._base.verify_batch(sigs, hashes, voters)
+
+
 def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--a-validators", type=int, default=32)
-    ap.add_argument("--b-validators", type=int, default=64)
-    ap.add_argument("--heights", type=int, default=3)
-    ap.add_argument("--interval-ms", type=int, default=3000)
-    ap.add_argument("--device-threshold", type=int, default=4)
-    ap.add_argument("--timeout", type=float, default=300.0)
+    ap = argparse.ArgumentParser(
+        description="M chains x N validators over one shared multi-tenant "
+                    "frontier, with saturating tenants")
+    ap.add_argument("--chains", type=int, default=3)
+    ap.add_argument("--validators", type=int, default=4,
+                    help="validators per chain")
+    ap.add_argument("--heights", type=int, default=3,
+                    help="target height per chain")
+    ap.add_argument("--saturate", type=int, default=1,
+                    help="how many chains flood their lane (first K)")
+    ap.add_argument("--interval-ms", type=int, default=100)
+    ap.add_argument("--max-batch", type=int, default=64,
+                    help="shared frontier flush size cap")
+    ap.add_argument("--linger-ms", type=float, default=10.0)
+    ap.add_argument("--tenant-queue-bound", type=int, default=48,
+                    help="per-tenant pending bound (arrivals over it shed "
+                         "to the host oracle)")
+    ap.add_argument("--tenant-weight", type=int, default=1)
+    ap.add_argument("--flood-burst", type=int, default=256,
+                    help="verify requests per flood burst (saturating "
+                         "tenants; > queue bound so sheds engage)")
+    ap.add_argument("--flood-pause-ms", type=float, default=10.0)
+    ap.add_argument("--flush-cost-ms", type=float, default=1.0,
+                    help="simulated device cost per batch flush")
+    ap.add_argument("--wait-ratio", type=float, default=3.0,
+                    help="max allowed light-tenant p50 queue-wait ratio")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-chain liveness timeout (s)")
+    ap.add_argument("--out-dir", default=None,
+                    help="also write each ledger line to its own JSON file")
     args = ap.parse_args()
+    if args.saturate >= args.chains:
+        ap.error("--saturate must leave at least one light chain")
 
-    os.environ.setdefault("CONSENSUS_PAD_MIN", "32")
-
-    from consensus_overlord_tpu.crypto.ecdsa_tpu import Sm2Crypto
-    from consensus_overlord_tpu.crypto.provider import Ed25519Crypto
+    from consensus_overlord_tpu.core.sm3 import sm3_hash
+    from consensus_overlord_tpu.crypto.provider import sim_crypto
+    from consensus_overlord_tpu.crypto.tenancy import SharedFrontier
+    from consensus_overlord_tpu.obs import Metrics, ledger, snapshot
     from consensus_overlord_tpu.sim import SimNetwork
 
-    # Prewarm the SM2 device kernel (first touch through the remote
-    # tunnel costs ~30 s; retried via crypto/warm.py against the flaky
-    # remote_compile endpoint).
-    from consensus_overlord_tpu.crypto.warm import rungs_for, warm_simple
-    warm = Sm2Crypto(0x7777, device_threshold=args.device_threshold)
-    warm_simple(warm, rungs_for(max(args.device_threshold,
-                                    args.a_validators, 8)))
+    async def flood(lane, stop: asyncio.Event, burst: int, pause_s: float,
+                    counters: dict) -> None:
+        """Saturating-tenant load: bursts of valid gossip-class verifies
+        far past the lane's queue bound.  Verdicts stay exact on the
+        shed path, so the flood proves flow control, not forgery."""
+        crypto = sim_crypto(b"\x5a" * 32)
+        h = sm3_hash(b"flood-traffic")
+        sig = crypto.sign(h)
+        voter = crypto.pub_key
+        while not stop.is_set():
+            results = await asyncio.gather(
+                *(lane.verify(sig, h, voter, msg_type="flood")
+                  for _ in range(burst)))
+            counters["sent"] += len(results)
+            counters["ok"] += sum(results)
+            try:
+                await asyncio.wait_for(stop.wait(), pause_s)
+            except asyncio.TimeoutError:
+                pass
 
-    async def run_chain(name, net, heights, timeout, metrics, profiler):
-        from consensus_overlord_tpu.obs import snapshot
+    async def run() -> int:
+        metrics = Metrics()
+        shared_provider = ThrottledProvider(sim_crypto(b"\x11" * 32),
+                                            args.flush_cost_ms / 1000.0)
+        shared = SharedFrontier(shared_provider, max_batch=args.max_batch,
+                                linger_s=args.linger_ms / 1000.0,
+                                metrics=metrics)
+        chains = []
+        for i in range(args.chains):
+            tid = f"chain{i}"
+            lane = shared.register(tid, weight=args.tenant_weight,
+                                   queue_bound=args.tenant_queue_bound)
+            net = SimNetwork(
+                n_validators=args.validators,
+                block_interval_ms=args.interval_ms,
+                seed=1000 + i,
+                crypto_factory=(lambda j, i=i: sim_crypto(
+                    ((0x6000 + 257 * i) * 4099 + j).to_bytes(4, "big") * 8)),
+                use_frontier=True, metrics=metrics,
+                frontier_factory=lambda crypto, lane=lane: lane)
+            chains.append({"tenant": tid, "lane": lane, "net": net,
+                           "saturating": i < args.saturate,
+                           "reached": False, "total_s": None})
 
+        stop_flood = asyncio.Event()
+        flood_counters = {"sent": 0, "ok": 0}
         t0 = time.perf_counter()
-        last = t0
-        ms = []
-        for h in range(1, heights + 1):
-            await net.run_until_height(h, timeout=timeout)
-            now = time.perf_counter()
-            ms.append((now - last) * 1000)
-            last = now
-        total = time.perf_counter() - t0
-        await net.stop()
-        srt = sorted(ms)
-        # Registry snapshot rides in the JSON tail the way sim/run.py's
-        # does (count/sum/total samples; full buckets stay on /metrics)
-        # so the MULTICHIP_* ledger carries batch-shape data per chain.
+        for c in chains:
+            c["net"].start(init_height=1)
+        flood_tasks = [
+            asyncio.get_running_loop().create_task(
+                flood(c["lane"], stop_flood, args.flood_burst,
+                      args.flood_pause_ms / 1000.0, flood_counters))
+            for c in chains if c["saturating"]]
+
+        async def run_chain(c) -> None:
+            start = time.perf_counter()
+            await c["net"].run_until_height(args.heights,
+                                            timeout=args.timeout)
+            c["total_s"] = round(time.perf_counter() - start, 3)
+            c["reached"] = True
+
+        failures = []
+        results = await asyncio.gather(*(run_chain(c) for c in chains),
+                                       return_exceptions=True)
+        stop_flood.set()
+        for task in flood_tasks:
+            task.cancel()
+        await asyncio.gather(*flood_tasks, return_exceptions=True)
+        for c, r in zip(chains, results):
+            if isinstance(r, BaseException):
+                failures.append(
+                    f"LIVENESS: {c['tenant']} missed height "
+                    f"{args.heights} within {args.timeout}s ({r!r})")
+        wall = time.perf_counter() - t0
+        for c in chains:
+            await c["net"].stop()
+        shared.close()
+        # Let the shutdown drain's in-flight batches resolve before the
+        # loop closes (close() schedules the worker release async).
+        await asyncio.sleep(0.05)
+
+        # -- acceptance: sheds engaged on every saturating tenant ---------
+        for c in chains:
+            s = c["lane"].tenant_stats
+            if c["saturating"] and s.sheds == 0:
+                failures.append(
+                    f"ADMISSION: saturating tenant {c['tenant']} never "
+                    f"shed (bound {args.tenant_queue_bound} too high or "
+                    f"flood too weak; requests={s.requests})")
+
+        # -- acceptance: light-tenant p50 queue-wait starvation bound -----
+        light = [c for c in chains if not c["saturating"]]
+        p50s = {c["tenant"]: c["lane"].tenant_stats.p50_wait_ms()
+                for c in light}
+        measured = {t: p for t, p in p50s.items() if p is not None}
+        if len(measured) != len(light):
+            failures.append(f"FAIRNESS: light tenant with no queue-wait "
+                            f"samples ({p50s})")
+        elif len(measured) > 1:
+            # Floor the reference at 1 ms: with sub-ms p50s the ratio is
+            # scheduler jitter, not starvation.
+            floor = max(min(measured.values()), 1.0)
+            for t, p in measured.items():
+                if p > args.wait_ratio * floor:
+                    failures.append(
+                        f"FAIRNESS: {t} p50 queue-wait {p:.2f}ms exceeds "
+                        f"{args.wait_ratio}x the lightest tenant's "
+                        f"({floor:.2f}ms)")
+
+        # -- per-tenant ledger records + combined line --------------------
+        out_dir = args.out_dir
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+
+        def emit(record: dict, name: str) -> None:
+            print(json.dumps(record))
+            if out_dir:
+                with open(os.path.join(out_dir, name + ".json"), "w") as f:
+                    json.dump(record, f, indent=2)
+
+        for c in chains:
+            status = c["lane"].status()
+            rate = (status["requests"] / wall) if wall > 0 else 0.0
+            emit(ledger.annotate({
+                "metric": "tenant-verify-throughput",
+                "value": round(rate, 2),
+                "unit": "verifies/s",
+                "context": {
+                    "tenant": c["tenant"],
+                    "saturating": c["saturating"],
+                    "chains": args.chains,
+                    "validators_per_chain": args.validators,
+                    "heights": args.heights,
+                    "queue_bound": args.tenant_queue_bound,
+                    "weight": args.tenant_weight,
+                },
+                "tenant": status,
+                "reached_height": c["reached"],
+                "chain_total_s": c["total_s"],
+            }), f"tenant_{c['tenant']}")
+
         scraped = snapshot(metrics.registry)
         obs = {k: v for k, v in scraped.items()
-               if k.split("{", 1)[0].endswith(("_count", "_sum",
-                                               "_total"))}
-        return {
-            "chain": name,
-            "validators": len(net.nodes),
-            "heights": heights,
-            "total_s": round(total, 3),
-            "p50_ms": round(srt[len(srt) // 2], 1),
-            "p95_ms": round(srt[-1], 1),
-            "delivered": net.router.delivered,
-            "metrics": obs,
-            "profile": profiler.summary(),
-        }
-
-    async def run() -> None:
-        from consensus_overlord_tpu.obs import DeviceProfiler, Metrics
-
-        # One registry + profiler PER CHAIN: the two fleets share a
-        # process (and a TPU) but must not share histograms, or chain
-        # B's host-path shape would pollute chain A's device numbers.
-        metrics_a, metrics_b = Metrics(), Metrics()
-        prof_a = DeviceProfiler(metrics_a)
-        prof_b = DeviceProfiler(metrics_b)
-        a = SimNetwork(
-            n_validators=args.a_validators,
-            block_interval_ms=args.interval_ms,
-            crypto_factory=lambda i: Sm2Crypto(
-                0x3000 + 7919 * i,
-                device_threshold=args.device_threshold),
-            use_frontier=True, frontier_linger_s=0.05,
-            metrics=metrics_a, profiler=prof_a, sim_device_crypto=True)
-        b = SimNetwork(
-            n_validators=args.b_validators,
-            block_interval_ms=args.interval_ms,
-            crypto_factory=lambda i: Ed25519Crypto(
-                (0x5000 + 7919 * i).to_bytes(4, "big") * 8),
-            use_frontier=True, frontier_linger_s=0.005,
-            metrics=metrics_b, profiler=prof_b, sim_device_crypto=True)
-        t0 = time.perf_counter()
-        a.start(init_height=1)
-        b.start(init_height=1)
-        ra, rb = await asyncio.gather(
-            run_chain("sm2-device", a, args.heights, args.timeout,
-                      metrics_a, prof_a),
-            run_chain("ed25519-host", b, args.heights, args.timeout,
-                      metrics_b, prof_b))
-        wall = time.perf_counter() - t0
-        from consensus_overlord_tpu.obs import ledger
-
-        # Every line is a ledger entry (per-chain + combined): the
-        # MULTICHIP_rNN tail self-describes like BENCH_rNN does.
-        print(json.dumps(ledger.annotate({**ra, "crypto": "sm2",
-                                          "tpu": True})))
-        print(json.dumps(ledger.annotate({**rb, "crypto": "ed25519",
-                                          "tpu": False})))
-        print(json.dumps(ledger.annotate({
-            "metric": "multi-chain-mixed-curve",
+               if k.split("{", 1)[0].endswith(("_count", "_sum", "_total"))}
+        emit(ledger.annotate({
+            "metric": "multichain-shared-frontier",
             "value": round(wall, 3),
             "unit": "wall_s",
-            "chains": 2,
-            "total_validators": args.a_validators + args.b_validators,
-            "heights_per_chain": args.heights,
-            "wall_s": round(wall, 3),
-        })))
+            "context": {
+                "chains": args.chains,
+                "saturating": args.saturate,
+                "validators_per_chain": args.validators,
+                "heights_per_chain": args.heights,
+                "max_batch": args.max_batch,
+                "linger_ms": args.linger_ms,
+                "flush_cost_ms": args.flush_cost_ms,
+                "queue_bound": args.tenant_queue_bound,
+            },
+            "tenants": shared.tenants_status(),
+            "frontier": {
+                "requests": shared.stats.requests,
+                "batches": shared.stats.batches,
+                "mean_batch": round(shared.stats.mean_batch, 2),
+                "max_batch": shared.stats.max_batch,
+                "failures": shared.stats.failures,
+            },
+            "flood": flood_counters,
+            "light_p50_wait_ms": p50s,
+            "failures": failures,
+            "ok": not failures,
+            "metrics": obs,
+        }), "multichain_combined")
 
-    asyncio.run(run())
+        if failures:
+            for f in failures:
+                print(f, file=sys.stderr)
+            return 2
+        return 0
+
+    sys.exit(asyncio.run(run()))
 
 
 if __name__ == "__main__":
