@@ -417,6 +417,14 @@ class Engine:
             if not ok:
                 logger.warning("%s: frontier dropped %s (bad signature)",
                                self._tag(), type(msg).__name__)
+                # Count the drop as an adversarial rejection under its
+                # own reason: with the frontier on, forged-signature
+                # traffic never reaches the per-message guards (bad_sig
+                # / non_validator), so fleet-scale Byzantine floods
+                # would otherwise be invisible in the rejection
+                # counters exactly when they ride the batched pipeline.
+                self._reject_byzantine("bad_sig_frontier",
+                                       msg=type(msg).__name__)
                 if self.recorder is not None:
                     self.recorder.record("frontier_drop",
                                          msg_type=type(msg).__name__,
@@ -1290,7 +1298,9 @@ class Engine:
         /metrics, and drop a flight-recorder event so a wedged
         adversarial run is diagnosable post-hoc via /statusz.  Reasons:
         bad_qc_sig, bad_bitmap, subquorum, equivocation, replay,
-        non_validator, bad_sig."""
+        non_validator, bad_sig, bad_sig_frontier (an invalid signature
+        dropped at the batching frontier before the per-message guards
+        could see it)."""
         if self.metrics is not None:
             self.metrics.byzantine_rejections.labels(reason=reason).inc()
         if self.recorder is not None:

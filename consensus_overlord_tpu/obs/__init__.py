@@ -57,6 +57,7 @@ _EXPORTS = {
     "ProfileSession": "prof",
     "annotate": "prof",
     "TelemetrySampler": "telemetry",
+    "drift_check": "telemetry",
     "JaegerExporter": "tracing",
     "Span": "tracing",
 }

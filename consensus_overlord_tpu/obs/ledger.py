@@ -93,6 +93,19 @@ MAX_STAGE_BLOWUP = 0.50
 PLATEAU_RUNS = 2
 PLATEAU_BAND = 0.01
 
+#: Soak survival dimensions (the "soak" block soak-chaos records carry)
+#: with their improvement direction — WAL-growth / RSS-slope / drop-rate
+#: regressions gate like perf regressions, but against a wide band:
+#: drift rates are few-sample and wall-clock noisy across CI hosts.
+SOAK_DIMENSIONS: Dict[str, bool] = {  # name -> higher_is_better
+    "rss_slope_bytes_per_s": False,
+    "wal_growth_bytes_per_s": False,
+    "flightrec_drop_per_s": False,
+    "commit_rate_heights_per_s": True,
+    "compile_cache_hit_ratio": True,
+}
+SOAK_BAND = 0.50
+
 
 # ---------------------------------------------------------------------------
 # environment fingerprint
@@ -207,6 +220,9 @@ class BenchRecord:
     #: "op/stage" -> {"count": int, "total_s": float} (prof.stage_totals)
     stages: Dict[str, dict] = field(default_factory=dict)
     occupancy: Optional[float] = None
+    #: Soak survival dimensions (numeric entries of the record's "soak"
+    #: block — SOAK_DIMENSIONS names the gated ones).
+    soak: Dict[str, float] = field(default_factory=dict)
     raw: dict = field(default_factory=dict)
 
     def stage_means(self) -> Dict[str, float]:
@@ -232,6 +248,8 @@ class BenchRecord:
             profile["occupancy"] = self.occupancy
         if profile:
             doc["profile"] = profile
+        if self.soak:
+            doc["soak"] = dict(self.soak)
         return doc
 
     @classmethod
@@ -249,6 +267,10 @@ class BenchRecord:
             context=dict(doc.get("context") or {}),
             stages=dict(profile.get("crypto_device_stage_seconds") or {}),
             occupancy=profile.get("occupancy"),
+            soak={k: float(v)
+                  for k, v in (doc.get("soak") or {}).items()
+                  if isinstance(v, (int, float))
+                  and not isinstance(v, bool)},
             raw=doc,
         )
 
@@ -401,6 +423,12 @@ def diff(a: BenchRecord, b: BenchRecord,
                       stage_band, higher_is_better=False)
         if d:
             deltas.append(d)
+    for key, higher_better in SOAK_DIMENSIONS.items():
+        if key in a.soak and key in b.soak:
+            d = _classify(f"soak {key}", a.soak[key], b.soak[key],
+                          SOAK_BAND, higher_is_better=higher_better)
+            if d:
+                deltas.append(d)
     return deltas
 
 
@@ -552,6 +580,22 @@ def check(records: Sequence[BenchRecord],
                 f"{means_prev[key] * 1e3:.3f} -> "
                 f"{means_cur[key] * 1e3:.3f} ms ({pct * 100:+.1f}%, "
                 f"limit +{max_stage_blowup * 100:.0f}%)", fatal=True))
+
+    # Soak survival dims gate like perf dims: a WAL-growth or RSS-slope
+    # rate that moved the wrong way past the (wide) SOAK_BAND is a
+    # leak regression, not noise.  Zero/absent baselines gate nothing
+    # (a healthy soak's WAL growth can legitimately be ~0).
+    for key, higher_better in SOAK_DIMENSIONS.items():
+        if key not in prev.soak or key not in cur.soak:
+            continue
+        d = _classify(f"soak {key}", prev.soak[key], cur.soak[key],
+                      SOAK_BAND, higher_is_better=higher_better)
+        if d is not None and d.verdict == "regressed":
+            findings.append(Finding(
+                "soak_drift",
+                f"{cur.run}: {key} {d.a:.6g} -> {d.b:.6g} "
+                f"({d.pct * 100:+.1f}%, band +/-{SOAK_BAND * 100:.0f}%)",
+                fatal=True))
 
     for i, j in plateaus(records, plateau_runs, plateau_band):
         if j == len(records) - 1:  # only a TRAILING plateau is news

@@ -43,7 +43,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["TelemetrySampler", "rss_bytes", "wal_size_bytes"]
+__all__ = ["DRIFT_THRESHOLD_DEFAULTS", "TelemetrySampler", "drift_check",
+           "rss_bytes", "wal_size_bytes"]
 
 #: Counter/gauge series worth carrying per sample (summed across label
 #: sets).  Deliberately a short allowlist: a soak file at 2 s cadence
@@ -325,6 +326,18 @@ class TelemetrySampler:
             d = delta(key, sub)
             if d is not None:
                 doc[name] = d
+        # Slopes/rates over the window: the soak lane's drift gate
+        # (drift_check) reads these directly instead of re-deriving
+        # delta/span by hand.
+        for rate, src in (("rss_slope_bytes_per_s", "rss_delta_bytes"),
+                          ("wal_growth_bytes_per_s", "wal_delta_bytes"),
+                          ("flightrec_drop_per_s",
+                           "flightrec_dropped_delta")):
+            if src in doc:
+                doc[rate] = round(doc[src] / span, 3)
+        cache = (last.get("compile_cache") or {})
+        if cache.get("hit_ratio") is not None:
+            doc["compile_cache_hit_ratio"] = cache["hit_ratio"]
         churn = doc.get("flightrec_recorded_delta")
         if churn is not None:
             doc["flightrec_events_per_s"] = round(churn / span, 3)
@@ -342,3 +355,61 @@ class TelemetrySampler:
         doc = self.trend()
         doc["recent"] = self.tail(8)
         return doc
+
+
+# ---------------------------------------------------------------------------
+# drift gates (the soak-chaos survival lane)
+# ---------------------------------------------------------------------------
+
+#: Default drift ceilings for a soak run.  Deliberately generous — a
+#: soak gate exists to catch a *leak* (monotone growth that would kill
+#: an hours-long run), not to flinch at warmup noise.  Ratios are
+#: floors (None/absent sample = not gated: a CPU sim may never touch
+#: the compile cache).
+DRIFT_THRESHOLD_DEFAULTS = {
+    "max_rss_slope_bytes_per_s": 4 * 1024 * 1024,
+    "max_wal_growth_bytes_per_s": 4 * 1024 * 1024,
+    "max_flightrec_drop_per_s": 50_000.0,
+    "min_compile_cache_hit_ratio": 0.0,
+}
+
+
+def drift_check(trend: dict, thresholds: Optional[dict] = None
+                ) -> List[str]:
+    """Evaluate a TelemetrySampler.trend() block against drift
+    ceilings; returns human-readable violations (empty = the soak
+    holds).  Pure and stdlib-only so the gate is unit-testable and the
+    CI lane can re-run it over an uploaded trend block.
+
+    Thresholds (missing keys fall back to DRIFT_THRESHOLD_DEFAULTS;
+    set a max to None to disable that gate):
+      max_rss_slope_bytes_per_s, max_wal_growth_bytes_per_s,
+      max_flightrec_drop_per_s, min_compile_cache_hit_ratio.
+    """
+    th = dict(DRIFT_THRESHOLD_DEFAULTS)
+    th.update(thresholds or {})
+    out: List[str] = []
+    if trend.get("samples", 0) < 2:
+        out.append(f"drift: too few samples to judge "
+                   f"({trend.get('samples', 0)} < 2)")
+        return out
+    for key, limit_key, label in (
+            ("rss_slope_bytes_per_s", "max_rss_slope_bytes_per_s",
+             "RSS slope"),
+            ("wal_growth_bytes_per_s", "max_wal_growth_bytes_per_s",
+             "WAL growth"),
+            ("flightrec_drop_per_s", "max_flightrec_drop_per_s",
+             "flight-recorder drop rate")):
+        limit = th.get(limit_key)
+        value = trend.get(key)
+        if limit is None or value is None:
+            continue
+        if value > limit:
+            out.append(f"drift: {label} {value:,.1f}/s exceeds "
+                       f"{limit:,.1f}/s over {trend.get('span_s')}s")
+    floor = th.get("min_compile_cache_hit_ratio")
+    ratio = trend.get("compile_cache_hit_ratio")
+    if floor and ratio is not None and ratio < floor:
+        out.append(f"drift: compile-cache hit ratio {ratio:.3f} below "
+                   f"{floor:.3f}")
+    return out

@@ -31,10 +31,23 @@ networking — and the behavior rewrites what leaves the node:
                copies later — delayed, reordered, to single peers —
                so receivers exercise the duplicate/stale-height guards
                (replay counter)
+  adaptive     switches between the static tactics on OBSERVED engine
+               state (the shim's hooks: leader rotation, the wrapped
+               engine's lock, reported view changes): withholds only
+               when it leads (or is about to lead) a round, equivocates
+               only while holding a lock QC as leader, replays hardest
+               during view-change storms, and stays honest otherwise —
+               the worst case the static behaviors approximate, because
+               every mutation lands exactly where the protocol is
+               tender.  Tactic switches are tallied shim-side
+               (`adaptive_switch`) so runs can assert the adversary
+               actually adapted rather than camping on one play.
 
 Determinism contract: a behavior draws only from its own seeded RNG
 (node seed = fleet seed ⊕ node index), so a given (seed, schedule)
 replays the same adversarial traffic modulo asyncio interleaving.
+The adaptive behavior adds no RNG draws of its own on the decision
+path — tactic choice is a pure function of observed engine state.
 
 Safety expectations are asserted by the runs that use this module:
 zero `SafetyViolation` from the SimController, target height reached,
@@ -47,7 +60,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..core.bitmap import build_bitmap
 from ..core.sm3 import sm3_hash
@@ -76,20 +90,29 @@ __all__ = ["AdversaryShim", "BEHAVIORS", "REJECTION_REASONS",
 #: Activation order for round-robin assignment (sim/run.py
 #: --chaos-byzantine N picks the first N): the rejection-producing
 #: behaviors come first so small counts still light up the counters.
-BEHAVIORS = ("equivocator", "forger", "replayer", "withholder")
+#: "adaptive" is appended LAST so legacy round-robin assignments
+#: (byzantine <= 4) keep the exact behaviors they had before it
+#: existed — seed stability across PRs.
+BEHAVIORS = ("equivocator", "forger", "replayer", "withholder",
+             "adaptive")
 
 #: reason labels in consensus_byzantine_rejections_total each behavior
 #: is expected to trip at honest receivers (acceptance asserts these
 #: are nonzero when the behavior was active; withholder produces
-#: silence, asserted via its own adversary_withhold tally instead).
+#: silence, asserted via its own adversary_withhold tally instead, and
+#: adaptive is asserted on its shim-side adaptive_switch tally — which
+#: tactics fire depends on observed state, so no single rejection
+#: reason is guaranteed).
 #: Caveat: non_validator needs the ENGINE to see the fabricated vote —
 #: with the batching frontier on, the invalid signature is dropped
-#: upstream, so sim/run.py skips that reason under --frontier/--tpu.
+#: upstream (and counted as bad_sig_frontier), so sim/run.py checks
+#: that counter instead under --frontier/--tpu.
 REJECTION_REASONS: Dict[str, Tuple[str, ...]] = {
     "equivocator": ("equivocation",),
     "forger": ("bad_qc_sig", "bad_bitmap", "non_validator"),
     "replayer": ("replay",),
     "withholder": (),
+    "adaptive": (),
 }
 
 
@@ -367,11 +390,125 @@ class Replayer(Behavior):
         self._replay_some()
 
 
+class Adaptive(Behavior):
+    """State-observing tactic switcher — the compromised validator that
+    watches its own honest engine and strikes where the protocol is
+    tender *right now* instead of camping on one play:
+
+      withhold    only when this node leads the current round or is
+                  about to lead (current round + 1, or round 0 of the
+                  next height) — silence from a leader costs the fleet
+                  a full choke/view-change cycle; silence from a
+                  follower costs one vote
+      equivocate  only while leading WITH a lock QC held — the lock
+                  path is where a conflicting proposal can actually
+                  split honest prevotes
+      replay      hardest during view-change storms (>= STORM_THRESHOLD
+                  view changes reported within the last
+                  STORM_WINDOW_HEIGHTS heights): duplicate stale votes
+                  land among genuine re-sends, where the dedup guards
+                  earn their keep
+      honest      otherwise — an adaptive adversary that is always
+                  attacking is just a noisy static one
+
+    Observed signals come exclusively through the shim's existing
+    surface: `leader_of` (the wrapped engine's rotation), the engine's
+    lock state, and the view changes the engine reported through
+    `report_view_change` (the shim records them before delegating).
+    Tactic choice draws no RNG, so a given engine trajectory picks the
+    same tactics; every switch is tallied (`adaptive_switch`, plus a
+    per-tactic `adaptive_<tactic>` count) for run assertions."""
+
+    name = "adaptive"
+
+    STORM_WINDOW_HEIGHTS = 4
+    STORM_THRESHOLD = 2
+    #: replay volleys per outbound message while the storm tactic is
+    #: active — "hardest" relative to the static Replayer's PER_SEND.
+    STORM_PER_SEND = 4
+
+    def __init__(self, shim: "AdversaryShim"):
+        super().__init__(shim)
+        self._tactics: Dict[str, Behavior] = {
+            "withhold": Withholder(shim),
+            "equivocate": Equivocator(shim),
+            "replay": Replayer(shim),
+        }
+        self._tactics["replay"].PER_SEND = self.STORM_PER_SEND
+        self._active: Optional[str] = None
+
+    # -- state observation -------------------------------------------------
+
+    def _leads_or_about_to(self) -> bool:
+        eng = self.shim.engine
+        if eng is None:
+            return False
+        me = self.shim.name
+        h, r = eng.height, eng.round
+        return (self.shim.leader_of(h, r) == me
+                or self.shim.leader_of(h, r + 1) == me
+                or self.shim.leader_of(h + 1, 0) == me)
+
+    def _holds_lock(self) -> bool:
+        eng = self.shim.engine
+        return eng is not None and getattr(eng, "lock_round", None) is not None
+
+    def _storming(self) -> bool:
+        eng = self.shim.engine
+        if eng is None:
+            return False
+        since = eng.height - self.STORM_WINDOW_HEIGHTS
+        return (self.shim.view_changes_since(since)
+                >= self.STORM_THRESHOLD)
+
+    def _pick_tactic(self) -> Optional[str]:
+        leading = self._leads_or_about_to()
+        if leading and self._holds_lock():
+            return "equivocate"
+        if leading:
+            return "withhold"
+        if self._storming():
+            return "replay"
+        return None
+
+    def _tick(self) -> Optional[Behavior]:
+        tactic = self._pick_tactic()
+        if tactic != self._active:
+            self.record("adaptive_switch",
+                        frm=self._active or "honest",
+                        to=tactic or "honest",
+                        height=(self.shim.engine.height
+                                if self.shim.engine is not None else -1))
+            if tactic is not None:
+                self.record(f"adaptive_{tactic}")
+            self._active = tactic
+        return self._tactics[tactic] if tactic is not None else None
+
+    # -- outbound hooks ----------------------------------------------------
+
+    async def on_broadcast(self, msg_type: str, payload: bytes) -> None:
+        tactic = self._tick()
+        if tactic is None:
+            await self.shim.inner.broadcast_to_other(msg_type, payload)
+        else:
+            await tactic.on_broadcast(msg_type, payload)
+
+    async def on_transmit(self, relayer: Address, msg_type: str,
+                          payload: bytes) -> None:
+        tactic = self._tick()
+        if tactic is None:
+            await self.shim.inner.transmit_to_relayer(relayer, msg_type,
+                                                      payload)
+        else:
+            await tactic.on_transmit(relayer, msg_type, payload)
+
+
 _BEHAVIOR_CLASSES = {
     "equivocator": Equivocator,
     "forger": Forger,
     "withholder": Withholder,
     "replayer": Replayer,
+    "adaptive": Adaptive,
 }
 
 
@@ -408,6 +545,10 @@ class AdversaryShim:
         #: event-kind -> count across every behavior ever armed here
         #: (outlives disarm; SimNetwork.restart_node carries it over)
         self.behavior_stats: Dict[str, int] = {}
+        #: view changes the wrapped engine reported (height, round,
+        #: reason), bounded — the adaptive behavior's storm signal.
+        self.observed_view_changes: Deque[Tuple[int, int, str]] = \
+            deque(maxlen=256)
 
     # -- toggles -----------------------------------------------------------
 
@@ -495,4 +636,11 @@ class AdversaryShim:
 
     def report_view_change(self, height: int, round: int,
                            reason: str) -> None:
+        self.observed_view_changes.append((height, round, reason))
         self.inner.report_view_change(height, round, reason)
+
+    def view_changes_since(self, height: int) -> int:
+        """View changes this node's engine reported at or above
+        `height` — the adaptive behavior's storm detector."""
+        return sum(1 for h, _, _ in self.observed_view_changes
+                   if h >= height)

@@ -35,14 +35,39 @@ fires every event whose height has been reached:
                (crypto/breaker.py raise_if_injected) — the breaker must
                open, route to the host oracle, half-open probe, and
                close again inside the same schedule as everything else
+  adaptive     arms the state-observing Adaptive behavior
+               (sim/adversary.py) on an upcoming leader — a byzantine
+               window whose tactics switch on live engine state.  Same
+               f-bound budget and fire-time target resolution as
+               `byzantine`
+  tenant_flood a flood task pumps invalid-signature verify bursts
+               (past the lane's queue bound) into the target node's
+               tenant lane on the fleet's SharedFrontier for
+               `duration_s` — Byzantine rejection floods riding the
+               real device-batched pipeline, overflow shedding to the
+               host oracle with exact verdicts
+  tenant_stall the SharedFrontier's device path stalls
+               (`inject_stall`) for `duration_s`: composed batches
+               sleep before dispatch, queues back up, the bounded
+               admission path sheds to the host oracle — the
+               shed-to-host-oracle survival story under a wedged
+               shared chip
 
 The f-bound invariant: the runner never lets crashed + Byzantine nodes
-exceed f = ⌊(n−1)/3⌋ concurrently (one for n=4).  An event that would
-breach it is DEFERRED one height (bounded retries), keeping schedules
-valid without making seeds fragile.  Chaos proves degraded-mode
-liveness and safety under f faults, not that BFT needs quorum;
-device_fault targets stay honest (degraded crypto, exact host-oracle
+(`byzantine` OR `adaptive` windows) exceed f = ⌊(n−1)/3⌋ concurrently
+(one for n=4).  An event that would breach it is DEFERRED one height
+(bounded retries), keeping schedules valid without making seeds
+fragile.  Chaos proves degraded-mode liveness and safety under f
+faults, not that BFT needs quorum; device_fault and tenant_* targets
+stay honest (degraded crypto / flow control, exact host-oracle
 results) and don't consume the budget.
+
+RNG draw-order contract (append-only): every new event family draws
+AFTER all legacy draws, so a schedule generated with the new counts at
+zero is bit-identical to the pre-existing generator's output AND —
+stronger — the legacy events in a schedule that DOES include new kinds
+keep their exact legacy heights/targets (the golden-fixture test in
+tests/test_adversary.py pins both).
 """
 
 from __future__ import annotations
@@ -69,17 +94,24 @@ __all__ = ["ChaosEvent", "ChaosSchedule", "ChaosRunner"]
 MAX_DEFERS = 64
 
 
+#: Event kinds that arm an adversary behavior and consume an f-bound
+#: budget slot ("adaptive" is its own kind so schedules and summaries
+#: name it, but budget-wise it IS a byzantine window).
+ADVERSARY_KINDS = ("byzantine", "adaptive")
+
+
 @dataclass(frozen=True)
 class ChaosEvent:
     at_height: int          # fire when the chain first commits this height
     kind: str               # "crash" | "stall" | "error" | "partition"
-    #                       # | "byzantine" | "device_fault"
-    node: int = -1          # crash/device_fault: validator index;
-    #                       # byzantine: -1 = runner picks an upcoming
-    #                       # leader at fire time
-    duration_s: float = 0.5  # downtime / fault / partition window
-    behavior: str = ""      # byzantine: adversary behavior name
-    heights: int = 0        # byzantine: active-window length in heights
+    #                       # | "byzantine" | "device_fault" | "adaptive"
+    #                       # | "tenant_flood" | "tenant_stall"
+    node: int = -1          # crash/device_fault/tenant_flood: validator
+    #                       # index; byzantine/adaptive: -1 = runner
+    #                       # picks an upcoming leader at fire time
+    duration_s: float = 0.5  # downtime / fault / flood / stall window
+    behavior: str = ""      # byzantine/adaptive: adversary behavior name
+    heights: int = 0        # byzantine/adaptive: window length in heights
     defers: int = 0         # times the runner pushed it back (f-bound)
 
 
@@ -94,7 +126,10 @@ class ChaosSchedule:
                  behaviors: Optional[List[str]] = None,
                  byz_window: Optional[int] = None,
                  downtime_s: float = 0.4, window_s: float = 0.4,
-                 device_window_s: float = 0.6) -> "ChaosSchedule":
+                 device_window_s: float = 0.6,
+                 adaptive: int = 0, tenant_floods: int = 0,
+                 tenant_stalls: int = 0,
+                 tenant_window_s: float = 0.8) -> "ChaosSchedule":
         """Derive a schedule from one seeded RNG.  Events land on
         distinct heights in [2, heights-1] — height 1 establishes the
         fleet, and the last height is post-fault runway proving
@@ -104,13 +139,23 @@ class ChaosSchedule:
         byzantine: number of adversary windows; `behaviors` names them
         explicitly (len == byzantine) or they round-robin through
         adversary.BEHAVIORS (rejection-producing behaviors first).
-        Each window lasts `byz_window` heights (default: n_validators,
-        so a leader-dependent behavior is guaranteed its turn when the
-        window fits the run).  Targets resolve at fire time (node=-1).
+        Each window lasts `byz_window` heights (default:
+        max(2, min(n_validators, 12)) — enough for the fire-time
+        target, an upcoming leader, to take its turn, without a
+        100-validator fleet arming for 100 heights).  Targets resolve
+        at fire time (node=-1).
+
+        adaptive: windows arming the state-observing Adaptive behavior
+        (its own event kind, same budget/window/target machinery).
+        tenant_floods / tenant_stalls: SharedFrontier attack windows
+        (no-ops, logged, when the fleet has no shared frontier).
 
         The RNG draw order is append-only: a schedule generated with
         byzantine=0 and device_faults=0 is bit-identical to one from
-        the pre-Byzantine harness (seeds stay stable across PRs)."""
+        the pre-Byzantine harness, and the new kinds (adaptive,
+        tenant_*) draw strictly AFTER every legacy draw — legacy
+        events keep their exact heights/targets even in a schedule
+        that includes new kinds (seeds stay stable across PRs)."""
         rng = random.Random(seed)
         # At most one crash per validator: targets are distinct, so more
         # crash events than validators is unsatisfiable.
@@ -134,7 +179,7 @@ class ChaosSchedule:
             raise ValueError(f"{byzantine} byzantine events but "
                              f"{len(behaviors)} behaviors named")
         window = byz_window if byz_window is not None \
-            else max(2, n_validators)
+            else max(2, min(n_validators, 12))
         events, ci, bi = [], 0, 0
         for at, kind in zip(slots, kinds):
             if kind == "crash":
@@ -154,7 +199,29 @@ class ChaosSchedule:
                     duration_s=device_window_s))
             else:
                 events.append(ChaosEvent(at, kind, duration_s=window_s))
+        # -- new kinds: every draw below is APPENDED after the legacy
+        # draws above, so the events above are bit-identical to what
+        # the legacy generator produced for this seed.
+        for _ in range(adaptive):
+            events.append(ChaosEvent(rng.choice(span), "adaptive",
+                                     node=-1, behavior="adaptive",
+                                     heights=window))
+        for _ in range(tenant_floods):
+            events.append(ChaosEvent(rng.choice(span), "tenant_flood",
+                                     node=rng.randrange(n_validators),
+                                     duration_s=tenant_window_s))
+        for _ in range(tenant_stalls):
+            events.append(ChaosEvent(rng.choice(span), "tenant_stall",
+                                     duration_s=tenant_window_s))
         return cls(events)
+
+    def shift(self, delta: int) -> "ChaosSchedule":
+        """The same schedule displaced `delta` heights later — the
+        soak-chaos lane replays freshly-seeded schedules cycle after
+        cycle against a chain whose height only grows."""
+        return ChaosSchedule([
+            dataclasses.replace(e, at_height=e.at_height + delta)
+            for e in self.events])
 
 
 class ChaosRunner:
@@ -185,7 +252,26 @@ class ChaosRunner:
         #: events whose heights were never reached (counted at drain —
         #: _pending is cleared there, so the summary needs the tally)
         self._never_reached = 0
+        #: per-adversary-window frontier batch marks: how many device
+        #: batches the fleet's frontier(s) flushed while the window was
+        #: armed — the "rejection floods rode the batched pipeline"
+        #: evidence, keyed by node index of the armed adversary.
+        self._frontier_marks: List[dict] = []
+        #: tenant_flood outcomes: one dict per fired flood window.
+        self.tenant_floods: List[dict] = []
+        #: tenant_stall windows fired.
+        self.tenant_stalls: List[dict] = []
         net.controller.on_new_height.append(self._on_height)
+
+    def detach(self) -> None:
+        """Unhook from the controller's new-height callback.  The
+        soak-chaos lane constructs one runner per chaos cycle against
+        one long-lived fleet; without this every spent runner would
+        keep firing its (empty) height scan forever."""
+        try:
+            self.net.controller.on_new_height.remove(self._on_height)
+        except ValueError:
+            pass
 
     @property
     def pending_count(self) -> int:
@@ -244,17 +330,17 @@ class ChaosRunner:
         taken before any task runs.  Returns the (possibly rewritten)
         event to fire, or None after deferring/dropping it.
 
-        The f-bound is the ISSUE invariant: Byzantine windows never
-        overlap crashes past f = ⌊(n−1)/3⌋ total faulty nodes.  Pure
-        crash-crash overlap keeps the pre-Byzantine harness contract
-        (distinct targets on distinct heights; a long downtime may
-        still briefly overlap the next crash window) so legacy chaos
-        schedules replay with their original timing."""
-        if ev.kind not in ("crash", "byzantine"):
+        The f-bound is the ISSUE invariant: Byzantine windows (either
+        adversary kind) never overlap crashes past f = ⌊(n−1)/3⌋ total
+        faulty nodes.  Pure crash-crash overlap keeps the pre-Byzantine
+        harness contract (distinct targets on distinct heights; a long
+        downtime may still briefly overlap the next crash window) so
+        legacy chaos schedules replay with their original timing."""
+        if ev.kind != "crash" and ev.kind not in ADVERSARY_KINDS:
             return ev
         node = ev.node
         armed = sum(1 for k in self._faulty.values() if k == "byzantine")
-        if ev.kind == "byzantine":
+        if ev.kind in ADVERSARY_KINDS:
             if node < 0:
                 node = self._pick_byzantine_target(height)
             ok = (node is not None and node not in self._faulty
@@ -286,7 +372,10 @@ class ChaosRunner:
             logger.info("chaos: deferring %s to height %d (f-bound)",
                         ev.kind, height + 1)
             return None
-        self._faulty[node] = ev.kind
+        # Both adversary kinds hold a "byzantine" budget slot (the
+        # disarm sweep frees by that label).
+        self._faulty[node] = ("byzantine" if ev.kind in ADVERSARY_KINDS
+                              else ev.kind)
         return dataclasses.replace(ev, node=node)
 
     def _pick_byzantine_target(self, height: int) -> Optional[int]:
@@ -312,6 +401,9 @@ class ChaosRunner:
             logger.exception("chaos: disarm of node %d failed", idx)
         if self._faulty.get(idx) == "byzantine":
             del self._faulty[idx]
+        for mark in self._frontier_marks:
+            if mark["node"] == idx and mark["batches_at_disarm"] is None:
+                mark["batches_at_disarm"] = self._frontier_batches()
 
     # -- event bodies ------------------------------------------------------
 
@@ -319,7 +411,7 @@ class ChaosRunner:
         entry = {"kind": ev.kind, "at_height": ev.at_height,
                  "fired_height": height, "node": ev.node,
                  "duration_s": ev.duration_s}
-        if ev.kind == "byzantine":
+        if ev.kind in ADVERSARY_KINDS:
             entry["behavior"] = ev.behavior
             entry["heights"] = ev.heights
         self.fired.append(entry)
@@ -333,10 +425,14 @@ class ChaosRunner:
                 self.net.controller.inject_fault(ev.kind, ev.duration_s)
             elif ev.kind == "partition":
                 await self._partition_flip(ev)
-            elif ev.kind == "byzantine":
+            elif ev.kind in ADVERSARY_KINDS:
                 self._arm_byzantine(ev, height)
             elif ev.kind == "device_fault":
                 self._inject_device_fault(ev)
+            elif ev.kind == "tenant_flood":
+                await self._tenant_flood(ev, entry)
+            elif ev.kind == "tenant_stall":
+                self._tenant_stall(ev, entry)
             else:
                 logger.warning("chaos: unknown event kind %r", ev.kind)
         except Exception:  # noqa: BLE001 — chaos must not crash the run
@@ -347,7 +443,7 @@ class ChaosRunner:
             # finally, and the other kinds never reserved — popping
             # unconditionally would release a slot some OTHER live
             # fault still owns (f-bound breach).
-            if ev.kind == "byzantine":
+            if ev.kind in ADVERSARY_KINDS:
                 self._faulty.pop(ev.node, None)
 
     async def _crash_restart(self, ev: ChaosEvent) -> None:
@@ -377,13 +473,46 @@ class ChaosRunner:
         await asyncio.sleep(ev.duration_s)
         self.net.router.set_partition()  # heal
 
+    def _frontier_batches(self) -> int:
+        """Device batches flushed by the fleet's frontier path so far:
+        the shared core's count when the fleet rides one, else the sum
+        over private per-node BatchingVerifiers (TenantLane handles
+        onto a shared core expose TenantStats, which has no batch
+        count — the core is the single source of truth there)."""
+        core = getattr(self.net, "shared_frontier", None)
+        if core is not None:
+            return core.stats.batches
+        total = 0
+        for n in self.net.nodes:
+            st = getattr(getattr(n, "frontier", None), "stats", None)
+            batches = getattr(st, "batches", None)
+            if batches:
+                total += batches
+        return total
+
     def _arm_byzantine(self, ev: ChaosEvent, height: int) -> None:
         self.net.set_behavior(ev.node, ev.behavior)
         self._disarm_at.append((height + max(ev.heights, 1), ev.node))
+        # Frontier batch mark: the delta to the disarm-time count is
+        # the "rejection floods hit the device-batched pipeline"
+        # evidence runs assert on (sim/run.py).
+        self._frontier_marks.append({
+            "node": ev.node, "behavior": ev.behavior,
+            "batches_at_arm": self._frontier_batches(),
+            "batches_at_disarm": None})
 
     def _inject_device_fault(self, ev: ChaosEvent) -> None:
         node = self.net.nodes[ev.node]
         breaker = getattr(node.crypto, "breaker", None)
+        core = getattr(self.net, "shared_frontier", None)
+        if core is not None:
+            # Shared-frontier fleet: the chip is SHARED — per-node
+            # cryptos only sign, so a node-local breaker would never
+            # see a device call (the fault window would idle out).
+            # The meaningful fault is the shared device failing.
+            shared_breaker = getattr(core._provider, "breaker", None)
+            if shared_breaker is not None:
+                breaker = shared_breaker
         if breaker is None or not hasattr(breaker, "inject_faults"):
             logger.warning("chaos: node %d crypto has no breaker; "
                            "device_fault skipped", ev.node)
@@ -400,6 +529,78 @@ class ChaosRunner:
                                  duration_s=ev.duration_s)
         self._breakers.append((breaker, breaker.times_opened,
                                breaker.total_injected))
+
+    # -- tenant events (SharedFrontier attack windows) ---------------------
+
+    def _tenant_lane(self, node_idx: int):
+        """The target node's tenant lane on the fleet's SharedFrontier,
+        or None (logged) when the fleet doesn't ride a shared core —
+        tenant events need the multi-tenant admission/fairness
+        machinery to attack."""
+        core = getattr(self.net, "shared_frontier", None)
+        if core is None:
+            logger.warning("chaos: fleet has no shared frontier; "
+                           "tenant event skipped")
+            return None
+        lane = getattr(self.net.nodes[node_idx], "frontier", None)
+        if lane is None or not hasattr(lane, "tenant_stats"):
+            logger.warning("chaos: node %d has no tenant lane; "
+                           "tenant event skipped", node_idx)
+            return None
+        return lane
+
+    async def _tenant_flood(self, ev: ChaosEvent, entry: dict) -> None:
+        """Pump invalid-signature verify bursts (each burst larger than
+        the lane's queue bound) into the target tenant's lane for the
+        window: rejection floods ride the real device-batched pipeline,
+        and overflow sheds to the host oracle with exact (False)
+        verdicts — flow control under attack, never a drop."""
+        from ..core.sm3 import sm3_hash
+
+        lane = self._tenant_lane(ev.node)
+        if lane is None:
+            return
+        node = self.net.nodes[ev.node]
+        if node.recorder is not None:
+            node.recorder.record("chaos_tenant_flood", node=ev.node,
+                                 tenant=lane.tenant_id,
+                                 duration_s=ev.duration_s)
+        sheds0 = lane.tenant_stats.sheds
+        failures0 = lane.tenant_stats.failures
+        burst = lane.queue_bound + 64
+        h = sm3_hash(b"chaos tenant flood")
+        sig, voter = b"\x00" * 32, b"\xff" * 32  # never verifies
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + ev.duration_s
+        sent = 0
+        while loop.time() < deadline:
+            results = await asyncio.gather(
+                *(lane.verify(sig, h, voter, msg_type="chaos_flood")
+                  for _ in range(burst)),
+                return_exceptions=True)
+            sent += len(results)
+        stats = {"node": ev.node, "tenant": lane.tenant_id,
+                 "sent": sent,
+                 "sheds": lane.tenant_stats.sheds - sheds0,
+                 "rejected": lane.tenant_stats.failures - failures0}
+        entry.update(stats)
+        self.tenant_floods.append(stats)
+
+    def _tenant_stall(self, ev: ChaosEvent, entry: dict) -> None:
+        """Wedge the shared core's device path for the window
+        (SharedFrontier.inject_stall): batches sleep before dispatch,
+        per-tenant queues back up, and the bounded admission path must
+        shed to the host oracle so every chain keeps committing."""
+        core = getattr(self.net, "shared_frontier", None)
+        if core is None or not hasattr(core, "inject_stall"):
+            logger.warning("chaos: fleet has no shared frontier; "
+                           "tenant_stall skipped")
+            return
+        core.inject_stall(ev.duration_s)
+        stats = {"duration_s": ev.duration_s,
+                 "sheds_at_stall": core.stats.sheds}
+        entry.update(stats)
+        self.tenant_stalls.append(stats)
 
     # -- teardown ----------------------------------------------------------
 
@@ -477,9 +678,15 @@ class ChaosRunner:
             "events": self.fired,
             "behaviors_active": sorted({e["behavior"]
                                         for e in self.fired
-                                        if e["kind"] == "byzantine"}),
+                                        if e["kind"] in ADVERSARY_KINDS}),
             "device_faults_fired": sum(1 for e in self.fired
                                        if e["kind"] == "device_fault"),
             "device_faults_effective": self.device_faults_effective,
+            "tenant_floods": self.tenant_floods,
+            "tenant_stalls": self.tenant_stalls,
+            # Device-batch throughput while each adversary window was
+            # armed: disarm-time minus arm-time batch counts (None =
+            # window still open — drain() closes them all).
+            "frontier_marks": self._frontier_marks,
             "f_bound": self.f,
         }

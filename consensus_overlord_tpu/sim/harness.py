@@ -177,7 +177,8 @@ class SimNetwork:
                  flight_recorder_capacity: int = 0, wal_factory=None,
                  sim_device_crypto: bool = False,
                  device_breaker_cooldown_s: float = 0.25,
-                 profiler=None, frontier_factory=None):
+                 profiler=None, frontier_factory=None,
+                 shared_frontier=None):
         """metrics: one shared obs.Metrics for the whole fleet (histograms
         aggregate across nodes — fine for sim-level batch/round shape).
         profiler: one shared obs.prof.DeviceProfiler — providers with a
@@ -192,7 +193,11 @@ class SimNetwork:
         SimDeviceCrypto (crypto/provider.py) so chaos `device_fault`
         events have a circuit breaker + simulated device path to break
         even in CPU-only fleets; providers that already carry a breaker
-        (TpuBlsCrypto) are left alone."""
+        (TpuBlsCrypto) are left alone.
+        shared_frontier: the SharedFrontier core behind frontier_factory
+        lanes, when the fleet rides one — held for introspection (chaos
+        tenant events, run summaries); the caller owns its lifecycle
+        (SimNetwork.stop never closes it)."""
         from ..obs.flightrec import FlightRecorder
 
         if crypto_factory is None:
@@ -226,6 +231,7 @@ class SimNetwork:
         self._use_frontier = use_frontier
         self._frontier_linger_s = frontier_linger_s
         self._frontier_factory = frontier_factory
+        self.shared_frontier = shared_frontier
         self._wal_factory = wal_factory
         self.nodes = [SimNode(c, self.router, self.controller,
                               wal=(wal_factory(i) if wal_factory is not None
@@ -292,8 +298,12 @@ class SimNetwork:
                        profiler=self.profiler,
                        frontier_factory=self._frontier_factory)
         # Adversary tallies span the crash like the flight recorder does
-        # (run assertions read them after the schedule has played out).
+        # (run assertions read them after the schedule has played out);
+        # so does the observed view-change window the adaptive behavior
+        # reads its storm signal from.
         node.adversary.behavior_stats = old.adversary.behavior_stats
+        node.adversary.observed_view_changes = \
+            old.adversary.observed_view_changes
         # The XLA capture session (if sim/run.py attached one to this
         # node's engine) survives the restart too — a crashed node 0
         # must not silently end profiling for the rest of the run.

@@ -15,6 +15,12 @@ import logging
 import sys
 import time
 
+#: Validator count at which runs default onto the batching frontier:
+#: at fleet scale the per-message host verify path is not the
+#: production shape — rejection floods and device faults must hit the
+#: coalesced device-batched pipeline (--no-frontier overrides).
+FLEET_FRONTIER_MIN = 16
+
 
 def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
     """Chaos acceptance beyond safety+liveness: every active adversary
@@ -30,9 +36,22 @@ def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
     summary = chaos.summary()
     # With the batching frontier on, invalid-signature traffic (the
     # forger's fabricated-identity votes) is dropped at the frontier
-    # before the engine's non_validator guard can see it.
+    # before the engine's non_validator guard can see it — but it is
+    # still COUNTED, under bad_sig_frontier (engine/smr.py).
     frontier_on = any(n.frontier is not None for n in net.nodes)
     for behavior in summary["behaviors_active"]:
+        if behavior == "adaptive":
+            # Which tactics fired depends on observed state; the
+            # deterministic obligation is that the adversary actually
+            # ADAPTED (shim-side tactic-switch tally, surviving
+            # crash-restarts like the other behavior stats).
+            switches = sum(
+                n.adversary.behavior_stats.get("adaptive_switch", 0)
+                for n in net.nodes)
+            assert switches > 0, (
+                "adaptive adversary active but no tactic switch "
+                "recorded")
+            continue
         reasons = REJECTION_REASONS[behavior]
         if not reasons:  # withholder: silence, not forgeries
             withheld = sum(
@@ -43,6 +62,22 @@ def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
             continue
         for reason in reasons:
             if frontier_on and reason == "non_validator":
+                # Provider-dependent disposition: a sim-grade
+                # signature from a fabricated identity may verify
+                # (SimHashCrypto — the engine then counts
+                # non_validator) or fail at the frontier (real
+                # schemes — counted as bad_sig_frontier).  Either
+                # way the fabricated vote must have been counted
+                # SOMEWHERE.
+                counted = (scraped.get(
+                    "consensus_byzantine_rejections_total"
+                    "{reason=non_validator}", 0)
+                    + scraped.get(
+                        "consensus_byzantine_rejections_total"
+                        "{reason=bad_sig_frontier}", 0))
+                assert counted > 0, (
+                    "forger active with the frontier on but neither "
+                    "non_validator nor bad_sig_frontier ticked")
                 continue
             count = scraped.get(
                 "consensus_byzantine_rejections_total"
@@ -66,6 +101,29 @@ def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
             assert count > 0, (
                 f"behavior {behavior} active but rejection counter "
                 f"{reason!r} stayed zero")
+    # Fleet-scale evidence: while an adversary window was armed on a
+    # frontier-riding fleet, the batched pipeline must have kept
+    # flushing device batches — rejection floods rode the real path,
+    # not a per-message host loop.
+    marks = [m for m in summary.get("frontier_marks", [])
+             if m["batches_at_disarm"] is not None]
+    if frontier_on and marks:
+        deltas = [m["batches_at_disarm"] - m["batches_at_arm"]
+                  for m in marks]
+        assert any(d > 0 for d in deltas), (
+            f"adversary windows armed on a frontier fleet but no "
+            f"device batch flushed during any window: {marks}")
+    # Tenant chaos: every flood must have engaged admission control
+    # (sheds > 0 — overflow went to the host oracle, not the floor)
+    # and its invalid signatures must have been rejected.
+    for flood in summary.get("tenant_floods", []):
+        assert flood["sheds"] > 0, (
+            f"tenant_flood on {flood['tenant']} never shed "
+            f"(sent={flood['sent']}) — the admission bound did not "
+            f"engage")
+        assert flood["rejected"] > 0, (
+            f"tenant_flood on {flood['tenant']} sent {flood['sent']} "
+            f"invalid verifies but none were rejected")
     if summary["device_faults_fired"]:
         if chaos.device_faults_effective == 0:
             # The window never bit: this crypto path made no device
@@ -124,6 +182,33 @@ def main() -> None:
     parser.add_argument("--chaos-forgers", type=int, default=0)
     parser.add_argument("--chaos-replayers", type=int, default=0)
     parser.add_argument("--chaos-withholders", type=int, default=0)
+    parser.add_argument("--chaos-adaptive", type=int, default=0,
+                        help="adaptive adversary windows: the armed "
+                        "node SWITCHES tactics on observed engine "
+                        "state (withhold only when about to lead, "
+                        "equivocate only holding a lock, replay "
+                        "hardest in view-change storms; honest "
+                        "otherwise).  Its own chaos event kind — "
+                        "drawn append-only after the legacy RNG "
+                        "draws, so legacy event timing is untouched; "
+                        "the run then also asserts nonzero "
+                        "tactic-switch counters")
+    parser.add_argument("--chaos-tenant-floods", type=int, default=0,
+                        help="tenant_flood events: a flood task pumps "
+                        "invalid-signature verify bursts past the "
+                        "target tenant's queue bound on the fleet's "
+                        "SharedFrontier (needs --shared-frontier, "
+                        "auto-enabled) — Byzantine rejection floods "
+                        "riding the device-batched pipeline, overflow "
+                        "shedding to the host oracle")
+    parser.add_argument("--chaos-tenant-stalls", type=int, default=0,
+                        help="tenant_stall events: the SharedFrontier "
+                        "device path stalls for the window; bounded "
+                        "queues must shed to the host oracle so the "
+                        "chain keeps committing")
+    parser.add_argument("--chaos-tenant-window-ms", type=float,
+                        default=800.0,
+                        help="tenant_flood / tenant_stall window length")
     parser.add_argument("--chaos-device-faults", type=int, default=0,
                         help="device_fault events: the target node's "
                         "crypto circuit breaker fails every device "
@@ -161,7 +246,29 @@ def main() -> None:
     parser.add_argument("--frontier", action="store_true",
                         help="verify inbound signatures at the batching "
                         "frontier (always on with --tpu: the device path "
-                        "needs coalesced batches + off-loop dispatch)")
+                        "needs coalesced batches + off-loop dispatch).  "
+                        "Auto-enabled at fleet scale (>= "
+                        f"{FLEET_FRONTIER_MIN} validators): Byzantine "
+                        "rejection floods must ride the device-batched "
+                        "pipeline there, not the per-message host path")
+    parser.add_argument("--no-frontier", action="store_true",
+                        help="force per-message host verify even at "
+                        "fleet scale (overrides the auto-enable; "
+                        "incompatible with --tpu/--shared-frontier)")
+    parser.add_argument("--shared-frontier", action="store_true",
+                        help="every validator feeds ONE SharedFrontier "
+                        "core (crypto/tenancy.py) through its own "
+                        "tenant lane instead of a private "
+                        "BatchingVerifier — the multi-tenant admission/"
+                        "fairness machinery under consensus traffic; "
+                        "required (and auto-enabled) by "
+                        "--chaos-tenant-*")
+    parser.add_argument("--tenant-queue-bound", type=int, default=512,
+                        help="per-tenant pending bound on the shared "
+                        "frontier (arrivals over it shed to the host "
+                        "oracle); sized well below the single-tenant "
+                        "default so chaos floods engage admission "
+                        "control at CI length")
     parser.add_argument("--frontier-linger-ms", type=float, default=2.0)
     parser.add_argument("--device-threshold", type=int, default=8,
                         help="batch size at which --tpu providers ship "
@@ -200,6 +307,45 @@ def main() -> None:
                         "(default with --soak-seconds: "
                         "soak_samples.jsonl; without it samples stay "
                         "in the in-memory window served at /statusz)")
+    parser.add_argument("--soak-chaos", action="store_true",
+                        help="the long-soak survival lane: after the "
+                        "initial schedule, keep the fleet under "
+                        "RECURRING seeded chaos cycles (each cycle a "
+                        "fresh schedule from a derived seed, shifted "
+                        "to the current height) until --soak-seconds "
+                        "is spent, then gate the telemetry drift "
+                        "rates (RSS slope, WAL growth, flight-"
+                        "recorder drop rate, compile-cache ratio) and "
+                        "emit one ledger soak BenchRecord.  Exit 3 on "
+                        "a drift breach.  Needs --chaos and "
+                        "--soak-seconds")
+    parser.add_argument("--soak-cycle-heights", type=int, default=12,
+                        help="heights each recurring soak-chaos "
+                        "schedule spans")
+    parser.add_argument("--soak-record", default="soak_record.json",
+                        help="where --soak-chaos writes its ledger "
+                        "BenchRecord (metric=soak-chaos-survival; "
+                        "scripts/ledger.py check gates WAL-growth/"
+                        "RSS-slope regressions across soaks)")
+    parser.add_argument("--soak-max-rss-slope-mb", type=float,
+                        default=4.0,
+                        help="drift gate: max RSS slope over the "
+                        "sample window, MB/s (<= 0 disables)")
+    parser.add_argument("--soak-max-wal-growth-mb", type=float,
+                        default=4.0,
+                        help="drift gate: max summed WAL growth rate, "
+                        "MB/s (<= 0 disables)")
+    parser.add_argument("--soak-max-flightrec-drop-rate", type=float,
+                        default=50000.0,
+                        help="drift gate: max flight-recorder eviction "
+                        "rate, events/s (<= 0 disables; rings evict "
+                        "routinely once full — this catches runaway "
+                        "churn, not steady state)")
+    parser.add_argument("--soak-min-cache-ratio", type=float,
+                        default=0.0,
+                        help="drift gate: min compile-cache hit ratio "
+                        "at soak end (0 disables; CPU sims may never "
+                        "touch the cache)")
     parser.add_argument("--flightrec", type=int, default=256,
                         help="per-node flight-recorder capacity (events); "
                         "rings are dumped if the run times out.  0 = off")
@@ -233,9 +379,25 @@ def main() -> None:
     byz_behaviors = explicit_behaviors or None
     n_byzantine = (len(explicit_behaviors) if explicit_behaviors
                    else args.chaos_byzantine)
-    if (n_byzantine or args.chaos_device_faults) and not args.chaos:
-        parser.error("--chaos-byzantine / --chaos-device-faults need "
-                     "--chaos")
+    n_tenant_events = args.chaos_tenant_floods + args.chaos_tenant_stalls
+    if (n_byzantine or args.chaos_device_faults or args.chaos_adaptive
+            or n_tenant_events) and not args.chaos:
+        parser.error("--chaos-byzantine / --chaos-device-faults / "
+                     "--chaos-adaptive / --chaos-tenant-* need --chaos")
+    if args.soak_chaos and not (args.chaos and args.soak_seconds > 0):
+        parser.error("--soak-chaos needs --chaos and --soak-seconds")
+    # Tenant chaos attacks the multi-tenant core; a fleet that doesn't
+    # ride one has nothing to attack.
+    shared_frontier_on = args.shared_frontier or n_tenant_events > 0
+    if args.no_frontier and (args.tpu or shared_frontier_on):
+        parser.error("--no-frontier is incompatible with --tpu / "
+                     "--shared-frontier / --chaos-tenant-*")
+    # Fleet-scale default: at FLEET_FRONTIER_MIN+ validators inbound
+    # signature verification rides the device-batched frontier — the
+    # production shape — unless explicitly forced off.
+    use_frontier = (not args.no_frontier
+                    and (args.frontier or args.tpu or shared_frontier_on
+                         or args.validators >= FLEET_FRONTIER_MIN))
 
     if args.crypto == "bls":
         if args.tpu:
@@ -298,7 +460,7 @@ def main() -> None:
         import tempfile
 
         from ..obs import (DeviceProfiler, Metrics, ProfileSession,
-                           TelemetrySampler, snapshot)
+                           TelemetrySampler, drift_check, snapshot)
         from ..obs.telemetry import wal_size_bytes
 
         metrics = Metrics()
@@ -318,11 +480,40 @@ def main() -> None:
             wal_tmp = tempfile.TemporaryDirectory(prefix="chaos_wal_")
             wal_factory = lambda i: FileWal(  # noqa: E731
                 f"{wal_tmp.name}/node{i}", metrics=metrics)
+        shared_core = None
+        frontier_factory = None
+        if shared_frontier_on:
+            # One device core for the whole fleet: every validator
+            # registers a tenant lane keyed on its pubkey, so a
+            # crash-restarted node re-registers INTO its existing lane
+            # (SharedFrontier.register is idempotent by tenant id).
+            # Verification in every sim provider depends only on
+            # (sig, hash, voter), so one verifying instance serves all.
+            from ..crypto.breaker import CircuitBreaker
+            from ..crypto.provider import SimDeviceCrypto, sim_crypto
+            from ..crypto.tenancy import SharedFrontier
+
+            shared_base = (factory(10**7) if factory is not None
+                           else sim_crypto(b"\x77" * 32))
+            shared_provider = SimDeviceCrypto(
+                shared_base,
+                breaker=CircuitBreaker(failure_threshold=3,
+                                       cooldown_s=0.25,
+                                       metrics=metrics),
+                metrics=metrics)
+            shared_provider.bind_profiler(profiler)
+            shared_core = SharedFrontier(
+                shared_provider, max_batch=1024,
+                linger_s=args.frontier_linger_ms / 1000.0,
+                metrics=metrics)
+            frontier_factory = lambda crypto: shared_core.register(  # noqa: E731
+                "v-" + crypto.pub_key[:4].hex(),
+                queue_bound=args.tenant_queue_bound)
         net = SimNetwork(n_validators=args.validators,
                          block_interval_ms=args.interval_ms,
                          seed=args.seed,
                          drop_rate=args.drop_rate, crypto_factory=factory,
-                         use_frontier=args.frontier or args.tpu,
+                         use_frontier=use_frontier,
                          frontier_linger_s=args.frontier_linger_ms / 1000.0,
                          metrics=metrics,
                          flight_recorder_capacity=args.flightrec,
@@ -334,7 +525,9 @@ def main() -> None:
                          # + occupancy) — the acceptance surface of the
                          # "profile" summary block — with zero hardware.
                          sim_device_crypto=True,
-                         profiler=profiler)
+                         profiler=profiler,
+                         frontier_factory=frontier_factory,
+                         shared_frontier=shared_core)
         # Soak telemetry: sample the fleet's drift axes on a cadence.
         # Collectors dereference net.nodes at sample time (chaos
         # crash-restarts swap node objects mid-run); WAL bytes sum the
@@ -390,13 +583,14 @@ def main() -> None:
         sampler.start()  # baseline sample lands before the first height
         net.start(init_height=1)
         chaos = None
-        if args.chaos:
-            from .chaos import ChaosRunner, ChaosSchedule
+        chaos_seed = (args.chaos_seed if args.chaos_seed is not None
+                      else args.seed)
 
-            schedule = ChaosSchedule.generate(
-                args.chaos_seed if args.chaos_seed is not None
-                else args.seed,
-                args.heights, args.validators,
+        def make_schedule(seed: int, heights: int):
+            from .chaos import ChaosSchedule
+
+            return ChaosSchedule.generate(
+                seed, heights, args.validators,
                 crashes=args.chaos_crashes, stalls=args.chaos_stalls,
                 partitions=args.chaos_partitions,
                 byzantine=n_byzantine,
@@ -405,21 +599,35 @@ def main() -> None:
                 byz_window=args.chaos_byz_window,
                 downtime_s=args.chaos_downtime_ms / 1000.0,
                 window_s=args.chaos_window_ms / 1000.0,
-                device_window_s=args.chaos_device_window_ms / 1000.0)
+                device_window_s=args.chaos_device_window_ms / 1000.0,
+                adaptive=args.chaos_adaptive,
+                tenant_floods=args.chaos_tenant_floods,
+                tenant_stalls=args.chaos_tenant_stalls,
+                tenant_window_s=args.chaos_tenant_window_ms / 1000.0)
+
+        if args.chaos:
+            from .chaos import ChaosRunner
+
+            schedule = make_schedule(chaos_seed, args.heights)
             chaos = ChaosRunner(net, schedule)
             for ev in schedule.events:
                 detail = ""
                 if ev.kind == "crash":
                     detail = f" (node {ev.node})"
-                elif ev.kind == "byzantine":
+                elif ev.kind in ("byzantine", "adaptive"):
                     detail = f" ({ev.behavior}, {ev.heights} heights)"
-                elif ev.kind == "device_fault":
+                elif ev.kind in ("device_fault", "tenant_flood"):
                     detail = f" (node {ev.node}, {ev.duration_s:.1f}s)"
+                elif ev.kind == "tenant_stall":
+                    detail = f" ({ev.duration_s:.1f}s)"
                 print(f"chaos: {ev.kind} armed at height {ev.at_height}"
                       + detail)
         t0 = time.perf_counter()
         last = t0
         height_ms = []
+        soak_cycles: list = []
+        soak_heights = 0
+        soak_wall_s = 0.0
 
         async def advance(h: int, label: str = "") -> None:
             """One height of progress; a miss is a liveness failure —
@@ -438,6 +646,12 @@ def main() -> None:
                     print(f"chaos summary: {json.dumps(chaos.summary())}",
                           file=sys.stderr)
                 print(f"router: {json.dumps(net.router.stats())}",
+                      file=sys.stderr)
+                # The drift series belongs in the post-mortem: a soak
+                # that died of a slow leak is only diagnosable from
+                # the telemetry trend, not from flight recorders alone.
+                print("telemetry trend: "
+                      + json.dumps(sampler.trend(), default=repr),
                       file=sys.stderr)
                 # Tear the fleet down before exiting: N live engine
                 # tasks dying with the loop would spray task-destroyed
@@ -487,9 +701,61 @@ def main() -> None:
                 # height at a time so a wedge is still a diagnosed
                 # liveness failure, not a silent hang.
                 soak_deadline = t0 + args.soak_seconds
-                while time.perf_counter() < soak_deadline:
-                    await advance(net.controller.latest_height + 1,
-                                  " (soak)")
+                soak_start_h = net.controller.latest_height
+                soak_start_t = time.perf_counter()
+                if args.soak_chaos:
+                    # The survival lane: recurring seeded chaos cycles
+                    # until the budget is spent.  Each cycle derives a
+                    # fresh schedule (seed + cycle stride — still
+                    # deterministic for a given --seed) shifted to the
+                    # chain's current height, fires it to completion,
+                    # drains, and asserts safety before the next one.
+                    from .chaos import ChaosRunner
+
+                    if chaos is not None:
+                        chaos.detach()  # the initial schedule is spent
+                    cycle = 0
+                    while time.perf_counter() < soak_deadline:
+                        cycle += 1
+                        base_h = net.controller.latest_height
+                        sched = make_schedule(
+                            chaos_seed + 10007 * cycle,
+                            args.soak_cycle_heights).shift(base_h)
+                        runner = ChaosRunner(net, sched)
+                        cap = (base_h + args.soak_cycle_heights
+                               + 4 * len(sched.events) + 8)
+                        while ((runner.pending_count
+                                or runner.byzantine_armed
+                                or runner.inflight_count)
+                               and net.controller.latest_height < cap
+                               and time.perf_counter() < soak_deadline):
+                            await advance(
+                                net.controller.latest_height + 1,
+                                f" (soak-chaos cycle {cycle})")
+                        await runner.drain()
+                        runner.detach()
+                        assert not net.controller.violations, (
+                            f"safety violations in soak cycle {cycle}: "
+                            f"{net.controller.violations}")
+                        s = runner.summary()
+                        soak_cycles.append({
+                            "cycle": cycle,
+                            "seed": chaos_seed + 10007 * cycle,
+                            "from_height": base_h,
+                            "to_height": net.controller.latest_height,
+                            "events_fired": s["events_fired"],
+                            "events_skipped": s["events_skipped"],
+                            "behaviors_active": s["behaviors_active"],
+                            "tenant_floods": s["tenant_floods"],
+                            "tenant_stalls": len(s["tenant_stalls"]),
+                        })
+                else:
+                    while time.perf_counter() < soak_deadline:
+                        await advance(net.controller.latest_height + 1,
+                                      " (soak)")
+                soak_heights = (net.controller.latest_height
+                                - soak_start_h)
+                soak_wall_s = time.perf_counter() - soak_start_t
         except Exception:
             if args.flightrec:
                 print(net.dump_flight_recorders(64), file=sys.stderr)
@@ -505,7 +771,14 @@ def main() -> None:
         # stop() unregisters every node — snapshot the router while the
         # fleet is still live so registered/partition state is truthful.
         router_stats = net.router.stats()
+        # Per-tenant state must be read before teardown too.
+        tenants_status = (shared_core.tenants_status()
+                          if shared_core is not None else None)
         await net.stop()
+        if shared_core is not None:
+            # Lanes' close() is a no-op; the run owns the core.
+            shared_core.close()
+            await asyncio.sleep(0.05)  # let the shutdown drain resolve
         # A capture the run ended mid-window must still flush its trace;
         # in the common case the capture already closed at a round
         # boundary, so fall back to where that one landed.
@@ -517,17 +790,29 @@ def main() -> None:
         def pct(q: float) -> float:
             return round(srt[min(len(srt) - 1, int(q * len(srt)))], 1)
 
-        stats = [n.frontier.stats for n in net.nodes
-                 if getattr(n, "frontier", None) is not None]
         frontier = {}
-        if stats:
-            batches = sum(s.batches for s in stats)
+        if shared_core is not None:
+            s = shared_core.stats
             frontier = {
-                "frontier_batches": batches,
-                "frontier_mean_batch": round(
-                    sum(s.requests for s in stats) / max(1, batches), 1),
-                "frontier_max_batch": max(s.max_batch for s in stats),
+                "frontier_batches": s.batches,
+                "frontier_mean_batch": round(s.mean_batch, 1),
+                "frontier_max_batch": s.max_batch,
+                "frontier_sheds": s.sheds,
+                "frontier_shared": True,
+                "tenants": tenants_status,
             }
+        else:
+            stats = [n.frontier.stats for n in net.nodes
+                     if getattr(n, "frontier", None) is not None]
+            if stats:
+                batches = sum(s.batches for s in stats)
+                frontier = {
+                    "frontier_batches": batches,
+                    "frontier_mean_batch": round(
+                        sum(s.requests for s in stats) / max(1, batches),
+                        1),
+                    "frontier_max_batch": max(s.max_batch for s in stats),
+                }
         # Scrape the fleet's shared registry into the summary: count/sum
         # pairs are enough to reconstruct means; full bucket detail stays
         # on /metrics.
@@ -567,8 +852,7 @@ def main() -> None:
         }
         if chaos is not None:
             out["chaos"] = {
-                "seed": (args.chaos_seed if args.chaos_seed is not None
-                         else args.seed),
+                "seed": chaos_seed,
                 "safety_violations": len(net.controller.violations),
                 **chaos.summary(),
             }
@@ -576,19 +860,103 @@ def main() -> None:
                 k.split("reason=", 1)[1].rstrip("}"): v
                 for k, v in scraped.items()
                 if k.startswith("consensus_byzantine_rejections_total{")}
-            if rejections or n_byzantine:
+            if rejections or n_byzantine or args.chaos_adaptive:
                 out["byzantine"] = {
                     "behaviors_active":
                         out["chaos"]["behaviors_active"],
                     "rejections": rejections,
                 }
+            # Shim-side adversary tallies summed across the fleet
+            # (adaptive_switch / adaptive_<tactic> / adversary_*):
+            # what the soak-chaos CI job asserts its adaptive windows
+            # actually adapted on.
+            adversary_stats: dict = {}
+            for n in net.nodes:
+                for k, v in n.adversary.behavior_stats.items():
+                    adversary_stats[k] = adversary_stats.get(k, 0) + v
+            out["adversary"] = adversary_stats
+        if args.soak_chaos:
+            trend = out["telemetry"]["trend"]
+            thresholds = {
+                "max_rss_slope_bytes_per_s":
+                    (args.soak_max_rss_slope_mb * 1024 * 1024
+                     if args.soak_max_rss_slope_mb > 0 else None),
+                "max_wal_growth_bytes_per_s":
+                    (args.soak_max_wal_growth_mb * 1024 * 1024
+                     if args.soak_max_wal_growth_mb > 0 else None),
+                "max_flightrec_drop_per_s":
+                    (args.soak_max_flightrec_drop_rate
+                     if args.soak_max_flightrec_drop_rate > 0 else None),
+                "min_compile_cache_hit_ratio": args.soak_min_cache_ratio,
+            }
+            drift_failures = drift_check(trend, thresholds)
+            breaker_cycles = scraped.get(
+                "crypto_breaker_transitions_total{to=closed}", 0)
+            soak_dims = {k: v for k, v in {
+                "rss_slope_bytes_per_s":
+                    trend.get("rss_slope_bytes_per_s"),
+                "wal_growth_bytes_per_s":
+                    trend.get("wal_growth_bytes_per_s"),
+                "flightrec_drop_per_s":
+                    trend.get("flightrec_drop_per_s"),
+                "compile_cache_hit_ratio":
+                    trend.get("compile_cache_hit_ratio"),
+                "commit_rate_heights_per_s":
+                    (round(soak_heights / soak_wall_s, 4)
+                     if soak_wall_s > 0 else None),
+                "breaker_cycles": breaker_cycles,
+                "chaos_cycles": len(soak_cycles),
+                "samples": sampler.samples_taken,
+                "safety_violations": len(net.controller.violations),
+            }.items() if v is not None}
+            out["soak_chaos"] = {
+                "cycles": soak_cycles,
+                "soak_heights": soak_heights,
+                "soak_wall_s": round(soak_wall_s, 3),
+                "thresholds": thresholds,
+                "drift_failures": drift_failures,
+                "soak": soak_dims,
+                "record_path": args.soak_record,
+            }
+            # The survival BenchRecord: one ledger line per soak, so
+            # `scripts/ledger.py trend` tracks commit rate and drift
+            # dims across PRs and `check` gates WAL-growth/RSS-slope
+            # regressions like perf regressions.
+            soak_record = ledger.annotate({
+                "metric": "soak-chaos-survival",
+                "value": soak_dims.get("commit_rate_heights_per_s", 0.0),
+                "unit": "heights/s",
+                "context": {
+                    "validators": args.validators,
+                    "seed": args.seed,
+                    "chaos_seed": chaos_seed,
+                    "soak_seconds": args.soak_seconds,
+                    "cycle_heights": args.soak_cycle_heights,
+                    "chaos_cycles": len(soak_cycles),
+                    "shared_frontier": shared_core is not None,
+                },
+                "soak": soak_dims,
+                "drift_failures": drift_failures,
+                "profile": profiler.summary(),
+            })
+            with open(args.soak_record, "w") as f:
+                json.dump(soak_record, f, indent=2)
+            print(json.dumps(soak_record))
+            for failure in drift_failures:
+                print(f"SOAK DRIFT FAILURE: {failure}", file=sys.stderr)
         return out
 
     from ..obs import ledger
 
     # The summary line IS a ledger entry: stamp the envelope (version,
     # ts, env fingerprint) so sim JSON tails diff/trend like BENCH_rNN.
-    print(json.dumps(ledger.annotate(asyncio.run(run()))))
+    out = asyncio.run(run())
+    print(json.dumps(ledger.annotate(out)))
+    if out.get("soak_chaos", {}).get("drift_failures"):
+        # Drift breaches are the soak lane's whole verdict: distinct
+        # from exit 2 (liveness failure) so CI can tell "died" from
+        # "leaking".
+        raise SystemExit(3)
 
 
 if __name__ == "__main__":

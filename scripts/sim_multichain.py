@@ -11,7 +11,10 @@ batch) so device occupancy is contended like a real chip under load.
 Saturating tenants run a flood task that pumps verify traffic far past
 their queue bound — admission control sheds the overflow to the
 host-oracle path (exact verdicts) while DWRR keeps composing fair
-batches for the light tenants.
+batches for the light tenants.  --adversarial K makes the first K
+saturators flood with INVALID signatures (the Byzantine tenant): the
+run then also fails if any garbage verify came back True, batched or
+shed.
 
 The run is the acceptance test; it exits nonzero unless:
 
@@ -74,6 +77,13 @@ def main() -> None:
                     help="target height per chain")
     ap.add_argument("--saturate", type=int, default=1,
                     help="how many chains flood their lane (first K)")
+    ap.add_argument("--adversarial", type=int, default=0,
+                    help="how many of the saturating tenants flood with "
+                         "INVALID signatures (first K of --saturate): a "
+                         "Byzantine tenant pumping garbage through the "
+                         "shared pipeline — every verdict must come back "
+                         "False (batched or shed), honest chains must "
+                         "still commit, and the fairness gate must hold")
     ap.add_argument("--interval-ms", type=int, default=100)
     ap.add_argument("--max-batch", type=int, default=64,
                     help="shared frontier flush size cap")
@@ -97,6 +107,8 @@ def main() -> None:
     args = ap.parse_args()
     if args.saturate >= args.chains:
         ap.error("--saturate must leave at least one light chain")
+    if args.adversarial > args.saturate:
+        ap.error("--adversarial tenants are a subset of --saturate")
 
     from consensus_overlord_tpu.core.sm3 import sm3_hash
     from consensus_overlord_tpu.crypto.provider import sim_crypto
@@ -105,17 +117,22 @@ def main() -> None:
     from consensus_overlord_tpu.sim import SimNetwork
 
     async def flood(lane, stop: asyncio.Event, burst: int, pause_s: float,
-                    counters: dict) -> None:
-        """Saturating-tenant load: bursts of valid gossip-class verifies
-        far past the lane's queue bound.  Verdicts stay exact on the
-        shed path, so the flood proves flow control, not forgery."""
+                    counters: dict, adversarial: bool = False) -> None:
+        """Saturating-tenant load: bursts of gossip-class verifies far
+        past the lane's queue bound.  Valid-signature floods prove flow
+        control under honest overload (verdicts stay exact on the shed
+        path); adversarial floods pump INVALID signatures — the
+        Byzantine-tenant case — and every verdict must come back False
+        whether it rode a device batch or shed to the host oracle."""
         crypto = sim_crypto(b"\x5a" * 32)
         h = sm3_hash(b"flood-traffic")
-        sig = crypto.sign(h)
+        sig = b"\x00" * len(crypto.sign(h)) if adversarial \
+            else crypto.sign(h)
         voter = crypto.pub_key
+        msg_type = "flood_adversarial" if adversarial else "flood"
         while not stop.is_set():
             results = await asyncio.gather(
-                *(lane.verify(sig, h, voter, msg_type="flood")
+                *(lane.verify(sig, h, voter, msg_type=msg_type)
                   for _ in range(burst)))
             counters["sent"] += len(results)
             counters["ok"] += sum(results)
@@ -146,18 +163,27 @@ def main() -> None:
                 frontier_factory=lambda crypto, lane=lane: lane)
             chains.append({"tenant": tid, "lane": lane, "net": net,
                            "saturating": i < args.saturate,
+                           "adversarial": i < args.adversarial,
                            "reached": False, "total_s": None})
 
         stop_flood = asyncio.Event()
         flood_counters = {"sent": 0, "ok": 0}
+        # Adversarial floods tally separately: their "ok" count must
+        # stay ZERO (an accepted garbage signature would be a forgery
+        # through the shared pipeline).
+        adv_counters = {"sent": 0, "ok": 0}
         t0 = time.perf_counter()
         for c in chains:
             c["net"].start(init_height=1)
-        flood_tasks = [
-            asyncio.get_running_loop().create_task(
+        flood_tasks = []
+        for c in chains:
+            if not c["saturating"]:
+                continue
+            counters = adv_counters if c["adversarial"] else flood_counters
+            flood_tasks.append(asyncio.get_running_loop().create_task(
                 flood(c["lane"], stop_flood, args.flood_burst,
-                      args.flood_pause_ms / 1000.0, flood_counters))
-            for c in chains if c["saturating"]]
+                      args.flood_pause_ms / 1000.0, counters,
+                      adversarial=c["adversarial"])))
 
         async def run_chain(c) -> None:
             start = time.perf_counter()
@@ -194,6 +220,15 @@ def main() -> None:
                     f"ADMISSION: saturating tenant {c['tenant']} never "
                     f"shed (bound {args.tenant_queue_bound} too high or "
                     f"flood too weak; requests={s.requests})")
+
+        # -- acceptance: adversarial floods were all rejected -------------
+        if adv_counters["ok"] > 0:
+            failures.append(
+                f"FORGERY: {adv_counters['ok']} of "
+                f"{adv_counters['sent']} invalid-signature flood "
+                f"verifies came back True")
+        if args.adversarial and adv_counters["sent"] == 0:
+            failures.append("ADVERSARIAL: flood task sent nothing")
 
         # -- acceptance: light-tenant p50 queue-wait starvation bound -----
         light = [c for c in chains if not c["saturating"]]
@@ -235,6 +270,7 @@ def main() -> None:
                 "context": {
                     "tenant": c["tenant"],
                     "saturating": c["saturating"],
+                    "adversarial": c["adversarial"],
                     "chains": args.chains,
                     "validators_per_chain": args.validators,
                     "heights": args.heights,
@@ -272,6 +308,7 @@ def main() -> None:
                 "failures": shared.stats.failures,
             },
             "flood": flood_counters,
+            "adversarial_flood": adv_counters,
             "light_p50_wait_ms": p50s,
             "failures": failures,
             "ok": not failures,

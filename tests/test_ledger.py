@@ -217,6 +217,119 @@ class PlateauAndCheck(unittest.TestCase):
         self.assertIn("r05", plateau[0].detail)
 
 
+def soak_rec(run, value=6.0, **soak):
+    return ledger.BenchRecord(run=run, metric="soak-chaos-survival",
+                              value=value, unit="heights/s",
+                              soak=soak)
+
+
+class SoakSurvivalGates(unittest.TestCase):
+    """Soak BenchRecords: the "soak" block round-trips, and check()
+    gates WAL-growth/RSS-slope regressions like perf regressions."""
+
+    def test_soak_block_round_trips(self):
+        doc = {"ledger_version": 1, "metric": "soak-chaos-survival",
+               "value": 6.2, "unit": "heights/s",
+               "soak": {"rss_slope_bytes_per_s": 1200.5,
+                        "wal_growth_bytes_per_s": 88,
+                        "chaos_cycles": 4,
+                        "drift_ok": True,        # non-numeric: dropped
+                        "note": "n/a"}}
+        r = ledger.load_record(doc, run="s1")
+        self.assertEqual(r.soak, {"rss_slope_bytes_per_s": 1200.5,
+                                  "wal_growth_bytes_per_s": 88.0,
+                                  "chaos_cycles": 4.0})
+        self.assertEqual(r.to_dict()["soak"], r.soak)
+
+    def test_check_fails_wal_growth_blowup(self):
+        prev = soak_rec("s1", wal_growth_bytes_per_s=100.0,
+                        rss_slope_bytes_per_s=1000.0)
+        cur = soak_rec("s2", wal_growth_bytes_per_s=400.0,
+                       rss_slope_bytes_per_s=1050.0)
+        findings = ledger.check([prev, cur])
+        drift = [f for f in findings if f.kind == "soak_drift"]
+        self.assertEqual(len(drift), 1, findings)
+        self.assertTrue(drift[0].fatal)
+        self.assertIn("wal_growth_bytes_per_s", drift[0].detail)
+
+    def test_check_passes_within_soak_band(self):
+        prev = soak_rec("s1", rss_slope_bytes_per_s=1000.0,
+                        flightrec_drop_per_s=50.0)
+        cur = soak_rec("s2", rss_slope_bytes_per_s=1400.0,
+                       flightrec_drop_per_s=60.0)  # +40% < 50% band
+        self.assertFalse(any(f.kind == "soak_drift"
+                             for f in ledger.check([prev, cur])))
+
+    def test_commit_rate_gates_downward(self):
+        # higher-is-better dim: a collapse in commit rate is fatal
+        prev = soak_rec("s1", commit_rate_heights_per_s=6.0)
+        cur = soak_rec("s2", commit_rate_heights_per_s=2.0)
+        findings = ledger.check([prev, cur])
+        self.assertTrue(any(f.kind == "soak_drift" and f.fatal
+                            for f in findings), findings)
+
+    def test_zero_baseline_gates_nothing(self):
+        prev = soak_rec("s1", wal_growth_bytes_per_s=0.0)
+        cur = soak_rec("s2", wal_growth_bytes_per_s=50.0)
+        self.assertFalse(any(f.kind == "soak_drift"
+                             for f in ledger.check([prev, cur])))
+
+    def test_diff_classifies_soak_dims(self):
+        prev = soak_rec("s1", rss_slope_bytes_per_s=1000.0)
+        cur = soak_rec("s2", rss_slope_bytes_per_s=2000.0)
+        deltas = {d.dimension: d.verdict
+                  for d in ledger.diff(prev, cur)}
+        self.assertEqual(deltas.get("soak rss_slope_bytes_per_s"),
+                         "regressed", deltas)
+
+
+class DriftCheckGate(unittest.TestCase):
+    """obs/telemetry.py drift_check: the soak-chaos lane's pure gate."""
+
+    TREND = {"samples": 20, "span_s": 300.0,
+             "rss_slope_bytes_per_s": 1_000_000.0,
+             "wal_growth_bytes_per_s": 2_048.0,
+             "flightrec_drop_per_s": 120.0,
+             "compile_cache_hit_ratio": 0.9}
+
+    def test_healthy_trend_passes_defaults(self):
+        from consensus_overlord_tpu.obs.telemetry import drift_check
+
+        self.assertEqual(drift_check(self.TREND), [])
+
+    def test_each_ceiling_trips_its_own_violation(self):
+        from consensus_overlord_tpu.obs.telemetry import drift_check
+
+        out = drift_check(self.TREND,
+                          {"max_rss_slope_bytes_per_s": 500_000})
+        self.assertEqual(len(out), 1)
+        self.assertIn("RSS slope", out[0])
+        out = drift_check(self.TREND,
+                          {"max_wal_growth_bytes_per_s": 1_000})
+        self.assertIn("WAL growth", out[0])
+        out = drift_check(self.TREND,
+                          {"max_flightrec_drop_per_s": 100})
+        self.assertIn("drop rate", out[0])
+        out = drift_check(self.TREND,
+                          {"min_compile_cache_hit_ratio": 0.95})
+        self.assertIn("hit ratio", out[0])
+
+    def test_disabled_and_absent_dims_gate_nothing(self):
+        from consensus_overlord_tpu.obs.telemetry import drift_check
+
+        self.assertEqual(drift_check(
+            self.TREND, {"max_rss_slope_bytes_per_s": None}), [])
+        sparse = {"samples": 5, "span_s": 30.0}  # no rates collected
+        self.assertEqual(drift_check(sparse), [])
+
+    def test_too_few_samples_is_itself_a_violation(self):
+        from consensus_overlord_tpu.obs.telemetry import drift_check
+
+        out = drift_check({"samples": 1})
+        self.assertEqual(len(out), 1)
+        self.assertIn("too few samples", out[0])
+
+
 class LedgerCLI(unittest.TestCase):
     """scripts/ledger.py exit-code contract (stdlib-only subprocesses —
     no jax import, so each run is interpreter-startup cheap)."""

@@ -543,3 +543,86 @@ class TestChaosHarness:
                     f"crashed node {i} never committed after restart"
             await net.stop()
         run(main(), timeout=90)
+
+
+# ---------------------------------------------------------------------------
+# Soak-chaos survival lane (sim/run.py --soak-chaos)
+# ---------------------------------------------------------------------------
+
+class TestSoakChaosLane:
+    def test_soak_chaos_cli_end_to_end(self, tmp_path):
+        """The whole --soak-chaos surface through the real CLI at smoke
+        length: recurring seeded cycles against a SharedFrontier fleet,
+        telemetry sampled throughout, the drift gate evaluated, and a
+        ledger-valid soak-chaos-survival BenchRecord written."""
+        import json
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        record = tmp_path / "soak_record.json"
+        samples = tmp_path / "samples.jsonl"
+        out = subprocess.run(
+            [sys.executable, "-m", "consensus_overlord_tpu.sim.run",
+             "--validators", "4", "--heights", "2", "--interval-ms", "40",
+             "--crypto", "simhash", "--chaos", "--seed", "5",
+             "--chaos-crashes", "1", "--chaos-stalls", "0",
+             "--chaos-partitions", "0", "--chaos-adaptive", "1",
+             "--chaos-tenant-floods", "1", "--shared-frontier",
+             "--soak-chaos", "--soak-seconds", "8",
+             "--sample-every", "0.5",
+             "--soak-out", str(samples), "--soak-record", str(record),
+             # warmup RSS growth over an 8 s window is all slope; the
+             # gate under test is the plumbing, not the ceiling values
+             "--soak-max-rss-slope-mb", "512"],
+            capture_output=True, text=True, timeout=300, cwd=repo,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+        lines = [ln for ln in out.stdout.splitlines()
+                 if ln.startswith("{")]
+        rec = json.loads(lines[0])     # the soak record line
+        summary = json.loads(lines[-1])
+        assert rec["metric"] == "soak-chaos-survival"
+        assert rec["unit"] == "heights/s" and rec["value"] > 0
+        assert rec["soak"]["safety_violations"] == 0
+        assert rec["soak"]["chaos_cycles"] >= 1
+        assert rec["drift_failures"] == []
+        sc = summary["soak_chaos"]
+        assert sc["soak_heights"] > 0
+        assert summary["adversary"].get("adaptive_switch", 0) > 0
+        floods = [f for c in sc["cycles"] for f in c["tenant_floods"]]
+        assert floods and all(f["sheds"] > 0 for f in floods), floods
+        assert summary["telemetry"]["samples"] >= 5
+        assert summary["frontier_batches"] > 0  # rode the shared core
+        # the record round-trips through the ledger (trend/check food)
+        from consensus_overlord_tpu.obs import ledger
+
+        loaded = ledger.load_record(json.load(open(record)), run="soak")
+        assert loaded.soak["commit_rate_heights_per_s"] > 0
+        assert samples.exists() and samples.read_text().count("\n") >= 5
+
+    def test_liveness_failure_dump_includes_telemetry_trend(self):
+        """The exit(2) post-mortem bugfix: a run that misses its height
+        target must dump the telemetry trend block alongside the flight
+        recorders (soak post-mortems need the drift series)."""
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # 2 validators cannot survive a crash (no quorum while down,
+        # and n=2 tolerates f=0 anyway): the run wedges and must exit 2
+        # with the full forensic dump.
+        out = subprocess.run(
+            [sys.executable, "-m", "consensus_overlord_tpu.sim.run",
+             "--validators", "2", "--heights", "4", "--interval-ms", "40",
+             "--crypto", "simhash", "--chaos", "--seed", "3",
+             "--chaos-crashes", "2", "--chaos-stalls", "0",
+             "--chaos-partitions", "0",
+             "--chaos-downtime-ms", "30000",
+             "--sample-every", "0.5", "--timeout", "6"],
+            capture_output=True, text=True, timeout=300, cwd=repo,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 2, (out.returncode, out.stderr[-800:])
+        assert "LIVENESS FAILURE" in out.stderr
+        assert "telemetry trend:" in out.stderr
+        assert "chaos summary:" in out.stderr
