@@ -1,0 +1,37 @@
+"""graftlint — AST-based invariant checker for this repo's load-bearing
+conventions.
+
+The hottest correctness properties of the codebase are not typecheckable:
+the int32-limb field kernels (ops/field.py) rely on trace-time overflow
+discipline, every TpuBlsCrypto device try-block must route failures
+through the CircuitBreaker/host-oracle fallback, the chaos generator's
+RNG draw order is append-only by contract, and obs/README.md's metric
+tables can drift silently from what obs/metrics.py registers.  This
+package walks the source with `ast` + `tokenize` (stdlib only — safe in
+any CI lane, no jax import) and enforces them as machine-checked rules:
+
+  TPU001  host-sync ops reachable inside jit/pallas-traced functions
+  TPU002  int32-limb upcast hazards in ops/
+  TPU003  jit recompile hazards (non-static Python args)
+  CONC001 class attributes written both under and outside the lock
+  CONC002 device-path except blocks that swallow without breaker/
+          host-fallback/metrics; uncontained device dispatches
+  OBS001  metric families / statusz sections out of sync across
+          obs/metrics.py, obs/README.md, tests, service/main.py
+  SIM001  chaos-generator RNG draws inserted before the append-only
+          legacy draw block (sim/chaos.py)
+  GL001   malformed `# graftlint: disable=` suppression (missing reason)
+  GL002   baseline entry without a reason
+
+Run it with `python scripts/graftlint.py` (see analysis/README.md for
+the rule catalog, the suppression syntax, and the baseline workflow).
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    LintResult,
+    Project,
+    all_rules,
+    load_baseline,
+    run_rules,
+)

@@ -1,0 +1,316 @@
+"""CONC rules: lock discipline (CONC001) and device-path failure
+containment (CONC002).
+
+CONC001 statically proves the repo's "one lock guards all state"
+convention (crypto/breaker.py docstring): within a class that takes
+`with self.<lock>:`, any attribute written both under the lock and
+outside it is a race.  Helper methods whose every intra-class call site
+sits under the lock (transitively — the `_transition` / "caller holds
+the lock" convention) count as lock-held; `__init__`-time writes are
+construction, not sharing, and are exempt.
+
+CONC002 enforces the PR 2 degraded-mode contract on device paths: an
+`except` that swallows a device dispatch/readback failure without
+feeding the breaker, falling back to the host oracle, logging, or
+counting it turns a sick accelerator into silent wrong behavior.  It
+also flags device dispatches (calls to module-jitted kernels /
+`device_get`) sitting outside any try at all — an uncontained XLA
+error there kills liveness instead of degrading throughput.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, Project, SourceFile
+from .rules_tpu import ModuleIndex, _dotted
+
+LOCK_FILE_GLOBS = (
+    "consensus_overlord_tpu/crypto/frontier.py",
+    "consensus_overlord_tpu/crypto/tenancy.py",
+    "consensus_overlord_tpu/crypto/breaker.py",
+    "consensus_overlord_tpu/crypto/tpu_provider.py",
+    "consensus_overlord_tpu/obs/telemetry.py",
+)
+
+DEVICE_FILE_GLOBS = (
+    "consensus_overlord_tpu/crypto/tpu_provider.py",
+    "consensus_overlord_tpu/crypto/ed25519_tpu.py",
+    "consensus_overlord_tpu/crypto/ecdsa_tpu.py",
+    "consensus_overlord_tpu/crypto/tenancy.py",
+)
+
+#: Presence of any of these in a try body marks it a device path.
+DEVICE_MARKERS = {"device_get", "addressable_shards", "_kernels",
+                  "raise_if_injected", "block_until_ready"}
+
+#: An except handler that reaches any of these has handled the failure:
+#: breaker feedback, host-oracle fallback, metrics, or logging.
+MITIGATION_NAMES = {
+    "_device_failed", "record_failure", "record_success",      # breaker
+    "verify_signature", "aggregate_signatures",                # host oracle
+    "_host_verify_all",
+    "verify_aggregated_signature", "_update_pubkeys_host", "_cpu",
+    "host_fallbacks", "device_failures", "labels", "inc", "observe",
+    "exception", "warning", "error", "info", "debug",          # logging
+}
+
+
+# ---------------------------------------------------------------------------
+# CONC001 — lock discipline
+# ---------------------------------------------------------------------------
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method facts: self-attribute writes and self-method calls,
+    each tagged with whether it happened under a `with self.<lock>`."""
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        self.depth = 0
+        #: [(attr, lineno, under_lock)]
+        self.writes: List[Tuple[str, int, bool]] = []
+        #: [(method, under_lock)]
+        self.calls: List[Tuple[str, bool]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(
+            isinstance(item.context_expr, ast.Attribute)
+            and isinstance(item.context_expr.value, ast.Name)
+            and item.context_expr.value.id == "self"
+            and item.context_expr.attr in self.lock_attrs
+            for item in node.items)
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    visit_AsyncWith = visit_With  # async-held locks count the same
+
+    def _record_target(self, target: ast.AST, lineno: int) -> None:
+        for node in ast.walk(target):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                self.writes.append((node.attr, lineno, self.depth > 0))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            self.calls.append((node.func.attr, self.depth > 0))
+        self.generic_visit(node)
+
+
+def _class_lock_findings(sf: SourceFile, cls: ast.ClassDef
+                         ) -> Iterable[Finding]:
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    lock_attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if (isinstance(ce, ast.Attribute)
+                        and isinstance(ce.value, ast.Name)
+                        and ce.value.id == "self"
+                        and "lock" in ce.attr.lower()):
+                    lock_attrs.add(ce.attr)
+    if not lock_attrs:
+        return
+
+    scans: Dict[str, _MethodScan] = {}
+    for m in methods:
+        scan = _MethodScan(lock_attrs)
+        scan.visit(m)
+        scans[m.name] = scan
+
+    # Fixpoint: a method is lock-held iff it has intra-class call sites
+    # and EVERY one of them is under the lock or inside a lock-held
+    # method ("caller holds the lock" helpers).
+    call_sites: Dict[str, List[Tuple[str, bool]]] = {}
+    for caller, scan in scans.items():
+        for callee, locked in scan.calls:
+            call_sites.setdefault(callee, []).append((caller, locked))
+    lock_held: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name in scans:
+            if name in lock_held:
+                continue
+            sites = call_sites.get(name, [])
+            if sites and all(locked or caller in lock_held
+                             for caller, locked in sites):
+                lock_held.add(name)
+                changed = True
+
+    locked_writes: Dict[str, List[int]] = {}
+    unlocked_writes: Dict[str, List[int]] = {}
+    for name, scan in scans.items():
+        if name in ("__init__", "__post_init__", "__new__"):
+            continue  # construction happens before the object is shared
+        for attr, lineno, under in scan.writes:
+            if attr in lock_attrs:
+                continue
+            bucket = (locked_writes if under or name in lock_held
+                      else unlocked_writes)
+            bucket.setdefault(attr, []).append(lineno)
+
+    for attr in sorted(set(locked_writes) & set(unlocked_writes)):
+        for lineno in sorted(unlocked_writes[attr]):
+            yield sf.finding(
+                "CONC001", lineno,
+                f"`self.{attr}` is written here without "
+                f"{'/'.join(sorted(lock_attrs))} but under it elsewhere "
+                f"in {cls.name} (lines "
+                f"{sorted(locked_writes[attr])}) — a torn read/write "
+                "race on shared state")
+
+
+def check_conc001(project: Project) -> Iterable[Finding]:
+    for sf in project.target_files(LOCK_FILE_GLOBS):
+        tree = sf.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                yield from _class_lock_findings(sf, node)
+
+
+# ---------------------------------------------------------------------------
+# CONC002 — device-path failure containment
+# ---------------------------------------------------------------------------
+
+def _names_in(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _is_device_try(body: List[ast.stmt], jit_names: Set[str]) -> bool:
+    names: Set[str] = set()
+    for stmt in body:
+        names |= _names_in(stmt)
+    return bool(names & (DEVICE_MARKERS | jit_names))
+
+
+def _handler_mitigates(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+    return bool(_names_in(handler) & MITIGATION_NAMES)
+
+
+def check_conc002(project: Project) -> Iterable[Finding]:
+    for sf in project.target_files(DEVICE_FILE_GLOBS):
+        tree = sf.tree
+        if tree is None:
+            continue
+        index = ModuleIndex(sf)
+        jit_names = {name for name, _node, _s in index.jit_wraps}
+        jit_factories = index.jit_factories()
+        jit_fns = {id(fn) for fn in index.reachable_from_entries()}
+
+        # (a) device try-blocks whose handlers swallow silently
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not _is_device_try(node.body, jit_names):
+                continue
+            for handler in node.handlers:
+                if not _handler_mitigates(handler):
+                    yield sf.finding(
+                        "CONC002", handler.lineno,
+                        "device-path except swallows the failure "
+                        "without breaker feedback, host fallback, "
+                        "metrics, or a log — a sick device degrades "
+                        "silently instead of visibly")
+
+        # (b) device dispatches outside any try: walk functions,
+        # tracking try-nesting; a call to a module-jitted kernel or
+        # device_get with no enclosing try is uncontained.  Jitted
+        # functions themselves are device-side composition and exempt;
+        # lambdas are indirection, not dispatch sites.
+        for fn in [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            if id(fn) in jit_fns:
+                continue
+            hits = _uncontained_dispatches(fn, jit_names, jit_factories)
+            if hits:
+                lineno, name = hits[0]
+                yield sf.finding(
+                    "CONC002", lineno,
+                    f"device dispatch `{name}` in `{fn.name}` is not "
+                    "inside any try — an XLA/PJRT failure here raises "
+                    "out of the provider instead of degrading to the "
+                    "host oracle through the breaker")
+
+
+def _uncontained_dispatches(fn: ast.AST, jit_names: Set[str],
+                            jit_factories: Set[str]
+                            ) -> List[Tuple[int, str]]:
+    hits: List[Tuple[int, str]] = []
+
+    def dispatch_name(call: ast.Call) -> Optional[str]:
+        name = _dotted(call.func).rsplit(".", 1)[-1]
+        if name in jit_names or name == "device_get":
+            return name
+        # `factory(args)(lanes...)` — calling a jit factory's RESULT
+        # is the dispatch (the inner call only builds the kernel)
+        if isinstance(call.func, ast.Call):
+            inner = _dotted(call.func.func).rsplit(".", 1)[-1]
+            if inner in jit_factories:
+                return inner
+        return None
+
+    def visit(node: ast.AST, in_try: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs are their own dispatch scopes
+        if isinstance(node, ast.Call) and not in_try:
+            name = dispatch_name(node)
+            if name is not None:
+                hits.append((node.lineno, name))
+        if isinstance(node, ast.Try):
+            # ONLY the try body is protected: exceptions raised in the
+            # handlers, else, or finally escape this try — a retry
+            # dispatch inside an except block is uncontained.
+            for stmt in node.body:
+                visit(stmt, True)
+            for other in (list(node.handlers) + node.orelse
+                          + node.finalbody):
+                visit(other, in_try)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_try)
+
+    for child in ast.iter_child_nodes(fn):
+        visit(child, False)
+    return hits
+
+
+RULES = {
+    "CONC001": check_conc001,
+    "CONC002": check_conc002,
+}

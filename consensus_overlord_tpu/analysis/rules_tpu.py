@@ -1,0 +1,447 @@
+"""TPU rules: jit purity (TPU001), int32-limb discipline (TPU002),
+recompile hazards (TPU003).
+
+All three work from the same per-module view: which functions are
+jit/pallas entry points, and which module-local functions are reachable
+from them.  Reachability is intra-module and name-based (calls to
+`name(...)`, `self.name(...)`, `Cls.name(...)` resolve to any same-named
+function defined in the module) — a deliberate over-approximation that
+errs toward checking more code; cross-module calls are not followed
+(the callee module is checked under its own entries).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, Project, SourceFile
+
+#: Where the device kernels and their host shims live.
+JIT_FILE_GLOBS = (
+    "consensus_overlord_tpu/ops/*.py",
+    "consensus_overlord_tpu/parallel/*.py",
+    "consensus_overlord_tpu/crypto/tpu_provider.py",
+    "consensus_overlord_tpu/crypto/ed25519_tpu.py",
+    "consensus_overlord_tpu/crypto/ecdsa_tpu.py",
+)
+
+OPS_FILE_GLOBS = ("consensus_overlord_tpu/ops/*.py",)
+
+#: Host-synchronizing calls that must never execute inside a traced
+#: function: each one either blocks on a device transfer (`.item()`,
+#: `float()` on a tracer, `np.asarray`, `jax.device_get`) or runs only
+#: at trace time and silently vanishes from the compiled computation
+#: (`print`).
+HOST_SYNC_ATTRS = {"item", "device_get"}
+HOST_SYNC_NAMES = {"float", "print"}
+#: `np.asarray` / `numpy.asarray` — jnp.asarray is the device-side twin
+#: and stays legal.
+HOST_NP_ROOTS = {"np", "numpy", "onp"}
+
+_I32_MAX = 2**31 - 1
+
+#: Functions that ARE the overflow guard: integer matrix products
+#: (einsum/dot/matmul) over int32 limb lanes are legal only inside the
+#: statically-planned reduction pipeline (ops/field.py `_reduce`, whose
+#: per-position bounds `_plan` proved fit int32).
+OVERFLOW_GUARD_FUNCS = {"_reduce", "_plan"}
+INT_MATMUL_FUNCS = {"einsum", "dot", "matmul", "tensordot"}
+
+#: Defaults of these constant types on a jitted function's parameters
+#: are Python values, not arrays: without static_argnums/static_argnames
+#: they either fail to trace (str/bytes/list/dict/set are not jax types)
+#: or force a retrace per distinct value.
+_NONARRAY_DEFAULTS = (str, bytes)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _is_pallas_ref(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d.endswith("pallas_call")
+
+
+class ModuleIndex:
+    """Functions, jit entries, and the name-based call graph of one
+    module."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        #: every function/lambda-free def in the module, by bare name
+        self.functions: Dict[str, List[ast.AST]] = {}
+        #: bare names of functions wrapped by jax.jit/pallas_call,
+        #: mapped to whether that wrap declared static argnums/argnames
+        self.jit_wraps: List[Tuple[str, ast.AST, bool]] = []
+        self._collect()
+
+    def _collect(self) -> None:
+        tree = self.sf.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+                for deco in node.decorator_list:
+                    has_static = False
+                    hit = False
+                    if _is_jit_ref(deco) or _is_pallas_ref(deco):
+                        hit = True
+                    elif isinstance(deco, ast.Call):
+                        # @jax.jit(...), @pl.pallas_call(...), and
+                        # @partial(jax.jit, static_argnums=...)
+                        if _is_jit_ref(deco.func) or _is_pallas_ref(
+                                deco.func):
+                            hit = True
+                            has_static = _call_has_static(deco)
+                        elif (_dotted(deco.func).endswith("partial")
+                              and deco.args
+                              and _is_jit_ref(deco.args[0])):
+                            hit = True
+                            has_static = _call_has_static(deco)
+                    if hit:
+                        self.jit_wraps.append((node.name, node, has_static))
+            elif isinstance(node, ast.Call):
+                wrapped: Optional[ast.AST] = None
+                if _is_jit_ref(node.func) and node.args:
+                    wrapped = node.args[0]
+                elif _is_pallas_ref(node.func) and node.args:
+                    wrapped = node.args[0]
+                if wrapped is not None:
+                    name = _dotted(wrapped).rsplit(".", 1)[-1]
+                    if name:
+                        self.jit_wraps.append(
+                            (name, node, _call_has_static(node)))
+
+    def jit_factories(self) -> Set[str]:
+        """Names of functions that build and return a jitted callable
+        (the `_verify_kernel(curve)(args...)` / `sharded_*(mesh)`
+        pattern): they contain a jit/pallas wrap and return something.
+        A call of their *result* is a device dispatch."""
+        wrap_lines = set()
+        for _name, node, _static in self.jit_wraps:
+            wrap_lines.add(node.lineno)
+        out: Set[str] = set()
+        for name, fns in self.functions.items():
+            for fn in fns:
+                span = range(fn.lineno,
+                             (fn.end_lineno or fn.lineno) + 1)
+                if (any(ln in span for ln in wrap_lines)
+                        and any(isinstance(n, ast.Return)
+                                and n.value is not None
+                                for n in ast.walk(fn))):
+                    out.add(name)
+        return out
+
+    def entry_functions(self) -> List[ast.AST]:
+        """FunctionDef nodes that are jit/pallas entries (decorated, or
+        referenced by name in a jit/pallas wrap call)."""
+        out: List[ast.AST] = []
+        seen: Set[int] = set()
+        for name, node, _static in self.jit_wraps:
+            targets = ([node] if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else self.functions.get(name, []))
+            for fn in targets:
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    out.append(fn)
+        return out
+
+    def reachable_from_entries(self) -> List[ast.AST]:
+        """Entry functions plus every module-local function reachable
+        from them through name-based calls (trace-time call graph)."""
+        worklist = self.entry_functions()
+        seen: Set[int] = {id(fn) for fn in worklist}
+        out: List[ast.AST] = []
+        while worklist:
+            fn = worklist.pop()
+            out.append(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _dotted(node.func).rsplit(".", 1)[-1]
+                for callee in self.functions.get(name, []):
+                    if id(callee) not in seen:
+                        seen.add(id(callee))
+                        worklist.append(callee)
+        return out
+
+
+def _call_has_static(call: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnums", "static_argnames")
+               for kw in call.keywords)
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """Fold a pure-literal integer expression (Constant / BinOp /
+    UnaryOp over constants) to its value — trace-time Python math is
+    exact and therefore exempt from TPU002's literal check."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = _const_int(node.left), _const_int(node.right)
+        if lhs is None or rhs is None:
+            return None
+        try:
+            op = node.op
+            if isinstance(op, ast.Add):
+                return lhs + rhs
+            if isinstance(op, ast.Sub):
+                return lhs - rhs
+            if isinstance(op, ast.Mult):
+                return lhs * rhs
+            if isinstance(op, ast.Pow):
+                return lhs ** rhs if abs(rhs) < 4096 else None
+            if isinstance(op, ast.LShift):
+                return lhs << rhs if rhs < 4096 else None
+            if isinstance(op, ast.RShift):
+                return lhs >> rhs
+            if isinstance(op, ast.FloorDiv) and rhs:
+                return lhs // rhs
+            if isinstance(op, ast.Mod) and rhs:
+                return lhs % rhs
+            if isinstance(op, ast.BitAnd):
+                return lhs & rhs
+            if isinstance(op, ast.BitOr):
+                return lhs | rhs
+            if isinstance(op, ast.BitXor):
+                return lhs ^ rhs
+        except (OverflowError, ValueError):
+            return None
+    return None
+
+
+def _mentions_device_math(fn: ast.AST) -> bool:
+    """Does this function's body touch jnp/lax?  Host-side helpers do
+    exact Python bigint math legitimately (digit decompositions, oracle
+    cross-checks); the int32-lane literal hazard only exists where the
+    arithmetic can land on device arrays."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in ("jnp", "lax"):
+            return True
+        if isinstance(node, ast.Attribute) and _dotted(node).startswith(
+                ("jax.numpy.", "jax.lax.")):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# TPU001 — host-sync ops inside jit
+# ---------------------------------------------------------------------------
+
+def check_tpu001(project: Project) -> Iterable[Finding]:
+    for sf in project.target_files(JIT_FILE_GLOBS):
+        if sf.tree is None:
+            continue
+        index = ModuleIndex(sf)
+        for fn in index.reachable_from_entries():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func)
+                name = dotted.rsplit(".", 1)[-1]
+                hit = None
+                if isinstance(node.func, ast.Attribute):
+                    if node.func.attr in HOST_SYNC_ATTRS:
+                        hit = f".{node.func.attr}()"
+                    elif (node.func.attr == "asarray"
+                          and dotted.split(".", 1)[0] in HOST_NP_ROOTS):
+                        hit = f"{dotted}()"
+                elif isinstance(node.func, ast.Name):
+                    if name in HOST_SYNC_NAMES:
+                        hit = f"{name}()"
+                    elif name == "device_get":
+                        hit = "device_get()"
+                if hit:
+                    yield sf.finding(
+                        "TPU001", node.lineno,
+                        f"host-sync op {hit} reachable inside the "
+                        f"jit/pallas-traced function "
+                        f"`{getattr(fn, 'name', '?')}` — it blocks on a "
+                        "device transfer or runs only at trace time")
+
+
+# ---------------------------------------------------------------------------
+# TPU002 — int32-limb upcast hazards in ops/
+# ---------------------------------------------------------------------------
+
+def _is_int64_ref(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "int64":
+        return True
+    d = _dotted(node)
+    return d.endswith("int64")
+
+
+def check_tpu002(project: Project) -> Iterable[Finding]:
+    for sf in project.target_files(OPS_FILE_GLOBS):
+        tree = sf.tree
+        if tree is None:
+            continue
+        # function ownership: the matmul check needs the guard-function
+        # name, the literal check needs the device-math gate
+        parents: Dict[int, Optional[str]] = {}
+        owner_fn: Dict[int, Optional[ast.AST]] = {}
+
+        def tag(node: ast.AST, owner: Optional[str],
+                fn: Optional[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_owner, child_fn = owner, fn
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_owner, child_fn = child.name, child
+                parents[id(child)] = child_owner
+                owner_fn[id(child)] = child_fn
+                tag(child, child_owner, child_fn)
+
+        parents[id(tree)] = None
+        owner_fn[id(tree)] = None
+        tag(tree, None, None)
+        device_fns: Dict[int, bool] = {}
+
+        def in_device_math(node: ast.AST) -> bool:
+            fn = owner_fn.get(id(node))
+            if fn is None:
+                return False  # module-level literal math is trace-time
+            if id(fn) not in device_fns:
+                device_fns[id(fn)] = _mentions_device_math(fn)
+            return device_fns[id(fn)]
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                # (a) .astype(int64) — an upcast escaping the int32
+                # lane discipline
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype" and node.args
+                        and _is_int64_ref(node.args[0])):
+                    yield sf.finding(
+                        "TPU002", node.lineno,
+                        ".astype(int64): the limb machine is int32-only"
+                        " — widen via the reduction pipeline instead")
+                # (a') jnp calls with dtype=int64
+                dotted = _dotted(node.func)
+                if dotted.startswith("jnp.") or dotted.startswith(
+                        "jax.numpy."):
+                    for kw in node.keywords:
+                        if kw.arg == "dtype" and _is_int64_ref(kw.value):
+                            yield sf.finding(
+                                "TPU002", node.lineno,
+                                f"{dotted}(dtype=int64): device arrays "
+                                "must stay int32 (TPU-native lanes)")
+                    for arg in node.args:
+                        if _is_int64_ref(arg) and _dotted(arg).startswith(
+                                ("jnp.", "np.", "numpy.")):
+                            if dotted.split(".")[-1] in (
+                                    "asarray", "array", "zeros", "ones",
+                                    "full", "arange"):
+                                yield sf.finding(
+                                    "TPU002", node.lineno,
+                                    f"{dotted}(..., int64): device "
+                                    "arrays must stay int32")
+                # (c) integer matrix products outside the overflow guard
+                if (dotted.split(".")[-1] in INT_MATMUL_FUNCS
+                        and dotted.split(".", 1)[0] in ("jnp", "jax")):
+                    owner = parents.get(id(node))
+                    if owner not in OVERFLOW_GUARD_FUNCS:
+                        yield sf.finding(
+                            "TPU002", node.lineno,
+                            f"{dotted} on limb lanes outside the "
+                            "overflow-guard pipeline (allowed only in "
+                            f"{sorted(OVERFLOW_GUARD_FUNCS)} where "
+                            "_plan proved the bounds fit int32)")
+            elif isinstance(node, ast.BinOp):
+                # (b) a big literal combined with a dynamic operand:
+                # the product/sum overflows int32 lanes at runtime.
+                # Pure-literal expressions fold to trace-time Python
+                # ints (exact) and are exempt.
+                if _const_int(node) is not None:
+                    continue
+                if not in_device_math(node):
+                    continue  # host-side Python bigint math is exact
+                for side in (node.left, node.right):
+                    v = _const_int(side)
+                    if v is not None and abs(v) > _I32_MAX:
+                        yield sf.finding(
+                            "TPU002", node.lineno,
+                            f"integer literal {v} (≥ 2**31) in "
+                            "arithmetic with a dynamic operand — int32 "
+                            "lanes overflow; route through the "
+                            "reduction pipeline or fold at trace time")
+
+
+# ---------------------------------------------------------------------------
+# TPU003 — recompile hazards: non-static Python args on jitted callables
+# ---------------------------------------------------------------------------
+
+def check_tpu003(project: Project) -> Iterable[Finding]:
+    for sf in project.target_files(JIT_FILE_GLOBS):
+        if sf.tree is None:
+            continue
+        index = ModuleIndex(sf)
+        flagged: Set[int] = set()
+        for name, wrap, has_static in index.jit_wraps:
+            if has_static:
+                continue
+            targets = ([wrap] if isinstance(
+                wrap, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else index.functions.get(name, []))
+            for fn in targets:
+                if id(fn) in flagged:
+                    continue
+                bad = _nonarray_params(fn)
+                if bad:
+                    flagged.add(id(fn))
+                    yield sf.finding(
+                        "TPU003", fn.lineno,
+                        f"jitted `{fn.name}` takes Python-valued "
+                        f"parameter(s) {bad} without static_argnums/"
+                        "static_argnames — each distinct value is a "
+                        "retrace (or a trace error for unhashable "
+                        "types)")
+
+
+def _nonarray_params(fn: ast.AST) -> List[str]:
+    """Parameter names whose defaults are Python (non-array) values:
+    str/bytes constants or list/dict/set/tuple displays."""
+    args = fn.args
+    bad: List[str] = []
+    pos = args.posonlyargs + args.args
+    for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                            args.defaults):
+        if _is_python_value(default):
+            bad.append(arg.arg)
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None and _is_python_value(default):
+            bad.append(arg.arg)
+    return bad
+
+
+def _is_python_value(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, _NONARRAY_DEFAULTS):
+        return True
+    return isinstance(node, (ast.List, ast.Dict, ast.Set, ast.Tuple))
+
+
+RULES = {
+    "TPU001": check_tpu001,
+    "TPU002": check_tpu002,
+    "TPU003": check_tpu003,
+}

@@ -33,6 +33,7 @@ reach the device (SURVEY.md §7 hard part (e)).
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -51,6 +52,8 @@ from .provider import CryptoError
 from .tpu_provider import _pad_to
 
 _SCALAR_BITS = 256
+
+logger = logging.getLogger("consensus_overlord_tpu.ecdsa_tpu")
 
 
 # ---------------------------------------------------------------------------
@@ -315,6 +318,13 @@ class _EcdsaFamilyCrypto:
 
     # -- batched verification ------------------------------------------------
 
+    def _host_verify_all(self, signatures, hashes, voters) -> List[bool]:
+        """Per-lane host path — below-threshold route AND device-failure
+        fallback.  One body: every path applies the same acceptance
+        rule (low-s / candidate-lift checks live in _scalars_of)."""
+        return [self.verify_signature(s, h, v)
+                for s, h, v in zip(signatures, hashes, voters)]
+
     def verify_batch(self, signatures: Sequence[bytes],
                      hashes: Sequence[bytes],
                      voters: Sequence[bytes]) -> List[bool]:
@@ -323,8 +333,7 @@ class _EcdsaFamilyCrypto:
         if n == 0:
             return []
         if n < self._threshold:
-            return [self.verify_signature(s, h, v)
-                    for s, h, v in zip(signatures, hashes, voters)]
+            return self._host_verify_all(signatures, hashes, voters)
         host, f = self.host, self._f
         rows = self._pk_rows_of(voters)
 
@@ -363,10 +372,20 @@ class _EcdsaFamilyCrypto:
             out[:n] = f.from_ints(vals)
             return jnp.asarray(out)
 
-        ok = _verify_kernel(self.curve_name)(
-            jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(vmask),
-            bits_of(u1), bits_of(u2), limbs_of(c1), limbs_of(c2))
-        return [bool(v) for v in np.asarray(ok)[:n]]
+        # Device dispatch/readback failures degrade to the per-lane host
+        # oracle (identical acceptance rule — low-s / candidate-lift
+        # checks all live in _scalars_of, shared by both paths) instead
+        # of raising out of the provider.
+        try:
+            ok = _verify_kernel(self.curve_name)(
+                jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(vmask),
+                bits_of(u1), bits_of(u2), limbs_of(c1), limbs_of(c2))
+            return [bool(v) for v in np.asarray(ok)[:n]]
+        except Exception as e:  # noqa: BLE001 — device path failed
+            logger.warning("%s device batch failed (%s: %s); host "
+                           "fallback", self.curve_name,
+                           type(e).__name__, e)
+            return self._host_verify_all(signatures, hashes, voters)
 
     # -- scheme internals ----------------------------------------------------
 

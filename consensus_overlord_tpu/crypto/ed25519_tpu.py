@@ -28,6 +28,7 @@ the device owns only the O(N) batch path, like the BLS provider.
 from __future__ import annotations
 
 import hashlib
+import logging
 import secrets
 from typing import List, Sequence
 
@@ -36,13 +37,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compile_cache import enable as _enable_compile_cache
-from ..core.sm3 import sm3_hash
 from ..ops import edwards as ed
-from .provider import CryptoError, Ed25519Crypto
+from .provider import Ed25519Crypto
 
 _enable_compile_cache()
 
 from .tpu_provider import _pad_to  # one shared pad ladder for all providers
+
+logger = logging.getLogger("consensus_overlord_tpu.ed25519_tpu")
 
 _Z_BITS = 128
 _SCALAR_BITS = 256
@@ -82,6 +84,15 @@ class Ed25519TpuCrypto(Ed25519Crypto):
         except Exception:  # noqa: BLE001 — malformed input is just False
             return False
 
+    def _host_verify_all(self, signatures, hashes, voters) -> List[bool]:
+        """Per-signature host path — the below-threshold route AND the
+        device-failure fallback.  One body on purpose: every path of
+        this provider must apply the same cofactored acceptance rule
+        (see module docstring), so there is exactly one place to hang a
+        future breaker/metric on."""
+        return [self.verify_signature(s, h, v)
+                for s, h, v in zip(signatures, hashes, voters)]
+
     def verify_batch(self, signatures: Sequence[bytes],
                      hashes: Sequence[bytes],
                      voters: Sequence[bytes]) -> List[bool]:
@@ -90,8 +101,7 @@ class Ed25519TpuCrypto(Ed25519Crypto):
         if n == 0:
             return []
         if n < self._threshold:
-            return [self.verify_signature(s, h, v)
-                    for s, h, v in zip(signatures, hashes, voters)]
+            return self._host_verify_all(signatures, hashes, voters)
 
         # Host parse: R from sig[:32], s from sig[32:] (must be < L), A
         # from the voter bytes; h_i = SHA512(R||A||M) mod L.
@@ -130,9 +140,20 @@ class Ed25519TpuCrypto(Ed25519Crypto):
             ok[:n] = parsed.wellformed
             return (jnp.asarray(y), jnp.asarray(sign), jnp.asarray(ok))
 
-        rx, ry, rz, rt, r_valid = _ed_decompress(*padded(pr))
-        ax, ay, az, at, a_valid = _ed_decompress(*padded(pa))
-        valid = (np.asarray(r_valid)[:n] & np.asarray(a_valid)[:n] & s_ok)
+        # Device dispatch/readback failures degrade to the per-signature
+        # host path (the SAME cofactored acceptance rule, so the verdict
+        # set is identical) instead of raising out of the provider — an
+        # XLA runtime error must cost throughput, never liveness.
+        # (CONC002: every device dispatch below stays inside this try.)
+        try:
+            rx, ry, rz, rt, r_valid = _ed_decompress(*padded(pr))
+            ax, ay, az, at, a_valid = _ed_decompress(*padded(pa))
+            valid = (np.asarray(r_valid)[:n] & np.asarray(a_valid)[:n]
+                     & s_ok)
+        except Exception as e:  # noqa: BLE001 — device path failed
+            logger.warning("ed25519 device decompress failed (%s: %s); "
+                           "host fallback", type(e).__name__, e)
+            return self._host_verify_all(signatures, hashes, voters)
         if not valid.any():
             return [False] * n
 
@@ -157,23 +178,32 @@ class Ed25519TpuCrypto(Ed25519Crypto):
             return jnp.concatenate(
                 [r_c, a_c, b_c[None], id_c[None]], axis=0)
 
-        neg_r = ed.neg(ed.EdPoint(rx, ry, rz, rt))
-        neg_a = ed.neg(ed.EdPoint(ax, ay, az, at))
         # Invalid lanes already have weight 0; scalar 0 · garbage-point is
         # still garbage under the scan (0·P = identity, safe: scalar_mul
         # with all-zero bits returns identity regardless of P — but the
         # scan ADDS P into acc only on set bits, so garbage coords never
         # enter).  Decompress-invalid lanes may carry non-curve coords;
         # zero weights keep them out of the sum.
-        bpt = ed.base_point(1)
-        idp = ed.identity_like(jnp.zeros((1, ed.FE.n), jnp.int32))
-        pts = ed.EdPoint(
-            cat(neg_r.x, neg_a.x, bpt.x[0], idp.x[0]),
-            cat(neg_r.y, neg_a.y, bpt.y[0], idp.y[0]),
-            cat(neg_r.z, neg_a.z, bpt.z[0], idp.z[0]),
-            cat(neg_r.t, neg_a.t, bpt.t[0], idp.t[0]))
-        ok = bool(_ed_msm_is_identity(pts.x, pts.y, pts.z, pts.t,
-                                      jnp.asarray(bits)))
+        # The try covers EVERY remaining device op — neg/base_point/
+        # identity_like/concatenate eagerly dispatch jnp work too, not
+        # just the jitted MSM — so no device failure escapes the
+        # provider (the CONC002 contract).
+        try:
+            neg_r = ed.neg(ed.EdPoint(rx, ry, rz, rt))
+            neg_a = ed.neg(ed.EdPoint(ax, ay, az, at))
+            bpt = ed.base_point(1)
+            idp = ed.identity_like(jnp.zeros((1, ed.FE.n), jnp.int32))
+            pts = ed.EdPoint(
+                cat(neg_r.x, neg_a.x, bpt.x[0], idp.x[0]),
+                cat(neg_r.y, neg_a.y, bpt.y[0], idp.y[0]),
+                cat(neg_r.z, neg_a.z, bpt.z[0], idp.z[0]),
+                cat(neg_r.t, neg_a.t, bpt.t[0], idp.t[0]))
+            ok = bool(_ed_msm_is_identity(pts.x, pts.y, pts.z, pts.t,
+                                          jnp.asarray(bits)))
+        except Exception as e:  # noqa: BLE001 — device MSM failed
+            logger.warning("ed25519 device MSM failed (%s: %s); host "
+                           "fallback", type(e).__name__, e)
+            return self._host_verify_all(signatures, hashes, voters)
         if ok:
             return [bool(v) for v in valid]
         # Localize: exact per-signature host verification.

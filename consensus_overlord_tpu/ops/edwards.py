@@ -259,6 +259,79 @@ def _host_decompress(blob: bytes):
     return (x, y)
 
 
+def _ext_add(p, q):
+    """Unified extended-coordinate addition (add-2008-hwcd-3, a = −1):
+    ~8 modmuls and no inversion, so host keygen/signing stays usable
+    without the optional `cryptography` package (the per-add inverted
+    affine form above costs a `pow(·, P-2)` per step — fine for one
+    verify, hopeless for generating thousands of fixture signatures)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 % P * D % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _ext_mul(pt_affine, k: int):
+    """[k]·pt over extended coords; returns affine (x, y)."""
+    x, y = pt_affine
+    q = (x, y, 1, x * y % P)
+    acc = (0, 1, 1, 0)  # identity
+    while k:
+        if k & 1:
+            acc = _ext_add(acc, q)
+        q = _ext_add(q, q)
+        k >>= 1
+    xr, yr, zr, _ = acc
+    zi = pow(zr, P - 2, P)
+    return (xr * zi % P, yr * zi % P)
+
+
+def _encode_point(pt_affine) -> bytes:
+    x, y = pt_affine
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def host_pub_key(seed32: bytes) -> bytes:
+    """RFC 8032 public key for a 32-byte seed, pure Python — the
+    keygen twin of host_sign (below)."""
+    import hashlib
+
+    h = hashlib.sha512(bytes(seed32)).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return _encode_point(_ext_mul((_B_X, _B_Y), a))
+
+
+def host_sign(seed32: bytes, message: bytes) -> bytes:
+    """RFC 8032 Ed25519 signature over `message`, pure Python ints —
+    the host fallback signer for environments without the
+    `cryptography` package (fixture generation in scripts/
+    bench_ed25519.py).  Verifies under host_verify_cofactored AND the
+    batched device relation (both accept every RFC 8032 signature)."""
+    import hashlib
+
+    seed32 = bytes(seed32)
+    h = hashlib.sha512(seed32).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    prefix = h[32:]
+    a_enc = _encode_point(_ext_mul((_B_X, _B_Y), a))
+    r = int.from_bytes(hashlib.sha512(prefix + bytes(message)).digest(),
+                       "little") % L
+    r_enc = _encode_point(_ext_mul((_B_X, _B_Y), r))
+    k = int.from_bytes(
+        hashlib.sha512(r_enc + a_enc + bytes(message)).digest(),
+        "little") % L
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little")
+
+
 def host_verify_cofactored(signature: bytes, message: bytes,
                            pubkey: bytes) -> bool:
     """[8]([s]B − R − [h]A) == identity over Python ints — bit-for-bit the

@@ -177,7 +177,6 @@ async def follower_verify(provider, authorities, qc_payload):
     from consensus_overlord_tpu.core.bitmap import extract_voters
     from consensus_overlord_tpu.core.sm3 import sm3_hash
     from consensus_overlord_tpu.core.types import AggregatedVote
-    from consensus_overlord_tpu.engine.smr import quorum_weight
 
     t0 = time.perf_counter()
     qc = AggregatedVote.decode(qc_payload)

@@ -1,0 +1,799 @@
+"""graftlint (consensus_overlord_tpu/analysis): per-rule fixtures —
+one true positive, one clean twin, one suppressed case each — plus the
+whole-repo smoke run (the tree must lint clean), the baseline
+round-trip, and the OBS001 doc-desync round-trip.
+
+Everything here is stdlib + pytest: the analyzer itself never imports
+jax, so these tests run in any lane.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import unittest
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from consensus_overlord_tpu.analysis import (  # noqa: E402
+    Project,
+    run_rules,
+)
+from consensus_overlord_tpu.analysis.core import (  # noqa: E402
+    load_baseline,
+    write_baseline,
+)
+from consensus_overlord_tpu.analysis.rules_sim import (  # noqa: E402
+    LEGACY_DRAWS,
+    SENTINEL,
+)
+
+
+def lint_snippet(tmp_path, source, rules, filename="fixture.py",
+                 **overrides):
+    """Run the given rules over one fixture file; returns LintResult."""
+    path = tmp_path / filename
+    path.write_text(source)
+    project = Project(str(tmp_path),
+                      overrides={"files": [str(path)], **overrides})
+    return run_rules(project, rules=rules)
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# TPU001 — host-sync ops inside jit
+# ---------------------------------------------------------------------------
+
+TPU001_BAD = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    y = helper(x)
+    print("tracing", y)
+    return y
+
+def helper(x):
+    return np.asarray(x) + 1
+"""
+
+TPU001_CLEAN = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    return jnp.asarray(x) + 1
+
+def host_decode(out):
+    # not reachable from the jitted entry: host-side sync is fine here
+    return np.asarray(jax.device_get(out))
+"""
+
+TPU001_SUPPRESSED = """\
+import jax
+
+@jax.jit
+def kernel(x):
+    print(x)  # graftlint: disable=TPU001 -- trace-time debug marker
+    return x
+"""
+
+
+class TestTPU001(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def test_true_positive(self):
+        result = lint_snippet(self.tmp, TPU001_BAD, ["TPU001"])
+        self.assertEqual(set(codes(result)), {"TPU001"})
+        # both the direct print and the np.asarray in the reachable
+        # helper are flagged
+        self.assertEqual(len(result.findings), 2)
+
+    def test_clean_twin(self):
+        result = lint_snippet(self.tmp, TPU001_CLEAN, ["TPU001"])
+        self.assertEqual(codes(result), [])
+
+    def test_suppressed(self):
+        result = lint_snippet(self.tmp, TPU001_SUPPRESSED, ["TPU001"])
+        self.assertEqual(codes(result), [])
+        self.assertEqual(len(result.suppressed), 1)
+
+
+# ---------------------------------------------------------------------------
+# TPU002 — int32-limb upcast hazards
+# ---------------------------------------------------------------------------
+
+TPU002_BAD = """\
+import jax.numpy as jnp
+
+def widen(x):
+    y = x.astype(jnp.int64)
+    z = jnp.einsum("ij,jk->ik", y, y)
+    return z * 3000000000
+"""
+
+TPU002_CLEAN = """\
+import jax.numpy as jnp
+
+_I32_MAX = 2**31 - 1  # pure-literal math folds at trace time
+
+def _reduce(x, fold):
+    return jnp.einsum("ij,jk->ik", x, fold)
+
+def narrow(x):
+    return _reduce(x.astype(jnp.int32), x) * 3
+"""
+
+TPU002_SUPPRESSED = """\
+import jax.numpy as jnp
+
+def widen(x):
+    # graftlint: disable=TPU002 -- documented one-off host staging copy
+    return x.astype(jnp.int64)
+"""
+
+
+class TestTPU002(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def test_true_positive(self):
+        result = lint_snippet(self.tmp, TPU002_BAD, ["TPU002"])
+        self.assertEqual(set(codes(result)), {"TPU002"})
+        # astype(int64) + einsum outside the guard + the big literal
+        self.assertEqual(len(result.findings), 3)
+
+    def test_clean_twin(self):
+        result = lint_snippet(self.tmp, TPU002_CLEAN, ["TPU002"])
+        self.assertEqual(codes(result), [])
+
+    def test_suppressed(self):
+        result = lint_snippet(self.tmp, TPU002_SUPPRESSED, ["TPU002"])
+        self.assertEqual(codes(result), [])
+        self.assertEqual(len(result.suppressed), 1)
+
+
+# ---------------------------------------------------------------------------
+# TPU003 — recompile hazards
+# ---------------------------------------------------------------------------
+
+TPU003_BAD = """\
+import jax
+
+@jax.jit
+def kernel(x, mode="fast"):
+    return x
+"""
+
+TPU003_CLEAN = """\
+from functools import partial
+
+import jax
+
+@partial(jax.jit, static_argnames=("mode",))
+def kernel(x, mode="fast"):
+    return x
+
+@jax.jit
+def plain(x, scale=None):
+    return x
+"""
+
+TPU003_SUPPRESSED = """\
+import jax
+
+# graftlint: disable=TPU003 -- mode is only ever passed one value
+@jax.jit
+def kernel(x, mode="fast"):
+    return x
+"""
+
+
+class TestTPU003(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def test_true_positive(self):
+        result = lint_snippet(self.tmp, TPU003_BAD, ["TPU003"])
+        self.assertEqual(codes(result), ["TPU003"])
+
+    def test_clean_twin(self):
+        result = lint_snippet(self.tmp, TPU003_CLEAN, ["TPU003"])
+        self.assertEqual(codes(result), [])
+
+    def test_suppressed(self):
+        result = lint_snippet(self.tmp, TPU003_SUPPRESSED, ["TPU003"])
+        self.assertEqual(codes(result), [])
+        self.assertEqual(len(result.suppressed), 1)
+
+
+# ---------------------------------------------------------------------------
+# CONC001 — lock discipline
+# ---------------------------------------------------------------------------
+
+CONC001_BAD = """\
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def bump(self):
+        with self._lock:
+            self.total += 1
+
+    def reset(self):
+        self.total = 0  # race: written elsewhere under the lock
+"""
+
+CONC001_CLEAN = """\
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def bump(self):
+        with self._lock:
+            self.total += 1
+
+    def reset(self):
+        with self._lock:
+            self._reset_locked()
+
+    def _reset_locked(self):
+        # "caller holds the lock" helper: every call site is locked
+        self.total = 0
+"""
+
+CONC001_SUPPRESSED = """\
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def bump(self):
+        with self._lock:
+            self.total += 1
+
+    def reset(self):
+        self.total = 0  # graftlint: disable=CONC001 -- single-threaded teardown
+"""
+
+
+class TestCONC001(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def test_true_positive(self):
+        result = lint_snippet(self.tmp, CONC001_BAD, ["CONC001"])
+        self.assertEqual(codes(result), ["CONC001"])
+        self.assertIn("total", result.findings[0].message)
+
+    def test_clean_twin(self):
+        result = lint_snippet(self.tmp, CONC001_CLEAN, ["CONC001"])
+        self.assertEqual(codes(result), [])
+
+    def test_suppressed(self):
+        result = lint_snippet(self.tmp, CONC001_SUPPRESSED, ["CONC001"])
+        self.assertEqual(codes(result), [])
+        self.assertEqual(len(result.suppressed), 1)
+
+
+# ---------------------------------------------------------------------------
+# CONC002 — device-path failure containment
+# ---------------------------------------------------------------------------
+
+CONC002_BAD = """\
+import jax
+
+@jax.jit
+def kernel(x):
+    return x
+
+class Provider:
+    def verify(self, x):
+        try:
+            out = kernel(x)
+            return jax.device_get(out)
+        except Exception:
+            return None  # swallowed: no breaker, fallback, or log
+
+    def dispatch_uncontained(self, x):
+        return kernel(x)  # no try at all
+"""
+
+CONC002_CLEAN = """\
+import logging
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+@jax.jit
+def kernel(x):
+    return x
+
+class Provider:
+    def verify(self, x):
+        try:
+            out = kernel(x)
+            return jax.device_get(out)
+        except Exception as e:
+            logger.warning("device failed: %s; host fallback", e)
+            return self.verify_signature(x)
+
+    def verify_signature(self, x):
+        return True
+"""
+
+CONC002_SUPPRESSED = """\
+import jax
+
+@jax.jit
+def kernel(x):
+    return x
+
+class Provider:
+    def probe(self, x):
+        try:
+            jax.device_get(kernel(x))
+        # graftlint: disable=CONC002 -- best-effort probe, result unused
+        except Exception:
+            pass
+"""
+
+
+class TestCONC002(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def test_true_positive(self):
+        result = lint_snippet(self.tmp, CONC002_BAD, ["CONC002"])
+        self.assertEqual(set(codes(result)), {"CONC002"})
+        # the swallowing handler + the uncontained dispatch
+        self.assertEqual(len(result.findings), 2)
+
+    def test_clean_twin(self):
+        result = lint_snippet(self.tmp, CONC002_CLEAN, ["CONC002"])
+        self.assertEqual(codes(result), [])
+
+    def test_suppressed(self):
+        result = lint_snippet(self.tmp, CONC002_SUPPRESSED, ["CONC002"])
+        self.assertEqual(codes(result), [])
+        self.assertEqual(len(result.suppressed), 1)
+
+    def test_retry_in_handler_is_uncontained(self):
+        """A dispatch inside an except block is NOT protected by the
+        try it handles — its failure escapes that try entirely."""
+        src = ("import logging\n\nimport jax\n\n"
+               "logger = logging.getLogger(__name__)\n\n"
+               "@jax.jit\ndef kernel(x):\n    return x\n\n"
+               "class Provider:\n"
+               "    def verify(self, x):\n"
+               "        try:\n"
+               "            return kernel(x)\n"
+               "        except Exception as e:\n"
+               "            logger.warning('retrying: %s', e)\n"
+               "            return kernel(x)\n")
+        result = lint_snippet(self.tmp, src, ["CONC002"])
+        self.assertEqual(codes(result), ["CONC002"])
+        self.assertIn("not inside any try", result.findings[0].message)
+        # a nested try around the retry contains it again
+        contained = src.replace(
+            "            logger.warning('retrying: %s', e)\n"
+            "            return kernel(x)\n",
+            "            logger.warning('retrying: %s', e)\n"
+            "            try:\n"
+            "                return kernel(x)\n"
+            "            except Exception:\n"
+            "                logger.error('gave up')\n"
+            "                return None\n")
+        result2 = lint_snippet(self.tmp, contained, ["CONC002"],
+                               filename="contained.py")
+        self.assertEqual(codes(result2), [])
+
+
+# ---------------------------------------------------------------------------
+# OBS001 — metric & statusz contract (fixture round-trip)
+# ---------------------------------------------------------------------------
+
+OBS_METRICS_SRC = """\
+from prometheus_client import Counter, Gauge, Histogram
+
+class Metrics:
+    def __init__(self):
+        self.verifies = Counter(
+            "crypto_verifies_total", "verifies", registry=None)
+        self.wait = Histogram(
+            "queue_wait_ms", "wait", registry=None)
+"""
+
+OBS_README_SRC = """\
+# obs
+
+## Metric families
+
+| family | type | labels | meaning |
+|---|---|---|---|
+| `crypto_verifies_total` | counter | — | verifies |
+| `queue_wait_ms` | histogram | — | wait |
+
+## /statusz
+
+Schema as wired by service/main.py:
+
+```json
+{
+  "ts": 0.0,
+  "consensus": {},
+  "frontier": {}
+}
+```
+"""
+
+OBS_MAIN_SRC = """\
+class Service:
+    def wire(self, metrics, engine, frontier):
+        metrics.add_status_source("consensus", engine.status)
+        metrics.add_status_source("frontier", frontier.status)
+"""
+
+OBS_USER_SRC = """\
+def observe(metrics):
+    metrics.verifies.inc()
+    metrics.wait.observe(1.0)
+"""
+
+
+def obs_project(tmp_path, metrics=OBS_METRICS_SRC, readme=OBS_README_SRC,
+                main=OBS_MAIN_SRC, user=OBS_USER_SRC):
+    (tmp_path / "metrics.py").write_text(metrics)
+    (tmp_path / "README.md").write_text(readme)
+    (tmp_path / "main.py").write_text(main)
+    (tmp_path / "pkg").mkdir(exist_ok=True)
+    (tmp_path / "pkg" / "user.py").write_text(user)
+    return Project(str(tmp_path), overrides={
+        "obs_metrics": "metrics.py",
+        "obs_readme": "README.md",
+        "service_main": "main.py",
+        "search_roots": ("pkg",),
+    })
+
+
+class TestOBS001(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def test_in_sync_is_clean(self):
+        result = run_rules(obs_project(self.tmp), rules=["OBS001"])
+        self.assertEqual(codes(result), [])
+
+    def test_registered_but_undocumented(self):
+        readme = OBS_README_SRC.replace(
+            "| `queue_wait_ms` | histogram | — | wait |\n", "")
+        result = run_rules(obs_project(self.tmp, readme=readme),
+                           rules=["OBS001"])
+        self.assertEqual(codes(result), ["OBS001"])
+        self.assertIn("queue_wait_ms", result.findings[0].message)
+        self.assertIn("missing", result.findings[0].message)
+
+    def test_documented_but_unregistered(self):
+        # desync the other way: rename the registered family so the
+        # README row goes stale — OBS001 must flag the README side too
+        metrics = OBS_METRICS_SRC.replace("queue_wait_ms",
+                                          "queue_delay_ms")
+        user = OBS_USER_SRC  # attr names unchanged
+        result = run_rules(obs_project(self.tmp, metrics=metrics,
+                                       user=user), rules=["OBS001"])
+        found = {(f.rule, f.path.split("/")[-1]) for f in result.findings}
+        self.assertIn(("OBS001", "README.md"), found)   # stale row
+        self.assertIn(("OBS001", "metrics.py"), found)  # new name undoc'd
+
+    def test_dead_family(self):
+        user = "def observe(metrics):\n    metrics.verifies.inc()\n"
+        result = run_rules(obs_project(self.tmp, user=user),
+                           rules=["OBS001"])
+        self.assertEqual(codes(result), ["OBS001"])
+        self.assertIn("never referenced", result.findings[0].message)
+
+    def test_statusz_desync(self):
+        main = OBS_MAIN_SRC + (
+            "        metrics.add_status_source(\"trend\", lambda: {})\n")
+        result = run_rules(obs_project(self.tmp, main=main),
+                           rules=["OBS001"])
+        self.assertEqual(codes(result), ["OBS001"])
+        self.assertIn("trend", result.findings[0].message)
+
+    def test_suppressed(self):
+        readme = OBS_README_SRC.replace(
+            "| `queue_wait_ms` | histogram | — | wait |\n", "")
+        metrics = OBS_METRICS_SRC.replace(
+            "        self.wait = Histogram(",
+            "        # graftlint: disable=OBS001 -- internal-only family\n"
+            "        self.wait = Histogram(")
+        result = run_rules(obs_project(self.tmp, metrics=metrics,
+                                       readme=readme), rules=["OBS001"])
+        self.assertEqual(codes(result), [])
+        self.assertEqual(len(result.suppressed), 1)
+
+
+# ---------------------------------------------------------------------------
+# SIM001 — append-only RNG draw order
+# ---------------------------------------------------------------------------
+
+def sim_chaos_src(extra_legacy_draw=False, sentinel=True,
+                  suppress=False):
+    lines = [
+        "import random",
+        "",
+        "class ChaosSchedule:",
+        "    @classmethod",
+        "    def generate(cls, seed, heights, n_validators,",
+        "                 adaptive=0):",
+        "        rng = random.Random(seed)",
+        "        slots = rng.sample(range(heights), 3)",
+        "        kinds = rng.choice(['crash'])",
+        "        rng.shuffle(slots)",
+        "        targets = rng.sample(range(n_validators), 2)",
+        "        node = rng.randrange(n_validators)",
+    ]
+    if extra_legacy_draw:
+        line = "        jitter = rng.random()"
+        if suppress:
+            line += ("  # graftlint: disable=SIM001 -- fixture: "
+                     "intentionally accepted draw")
+        lines.append(line)
+    if sentinel:
+        lines.append(f"        # {SENTINEL}")
+    lines.append("        extras = [rng.choice(slots)"
+                 " for _ in range(adaptive)]")
+    lines.append("        return (slots, kinds, targets, node, extras)")
+    return "\n".join(lines) + "\n"
+
+
+class TestSIM001(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def run_sim(self, src):
+        path = self.tmp / "chaos.py"
+        path.write_text(src)
+        project = Project(str(self.tmp),
+                          overrides={"sim_chaos": "chaos.py"})
+        return run_rules(project, rules=["SIM001"])
+
+    def test_clean_twin(self):
+        self.assertEqual(codes(self.run_sim(sim_chaos_src())), [])
+
+    def test_inserted_draw_above_sentinel(self):
+        result = self.run_sim(sim_chaos_src(extra_legacy_draw=True))
+        self.assertEqual(codes(result), ["SIM001"])
+        self.assertIn("re-seeds every recorded", result.findings[0].message)
+
+    def test_missing_sentinel(self):
+        result = self.run_sim(sim_chaos_src(sentinel=False))
+        self.assertEqual(codes(result), ["SIM001"])
+        self.assertIn("sentinel", result.findings[0].message)
+
+    def test_suppressed(self):
+        result = self.run_sim(sim_chaos_src(extra_legacy_draw=True,
+                                            suppress=True))
+        self.assertEqual(codes(result), [])
+        self.assertEqual(len(result.suppressed), 1)
+
+    def test_pinned_sequence_matches_real_generator(self):
+        """The pin in rules_sim must describe the REAL sim/chaos.py —
+        if this fails, generate() changed its legacy draw block."""
+        project = Project(REPO_ROOT)
+        result = run_rules(project, rules=["SIM001"])
+        self.assertEqual(codes(result), [],
+                         msg="sim/chaos.py legacy draws drifted from "
+                             f"LEGACY_DRAWS={LEGACY_DRAWS}")
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline machinery
+# ---------------------------------------------------------------------------
+
+class TestSuppressionSyntax(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def test_reasonless_suppression_is_gl001(self):
+        src = ("import jax\n\n@jax.jit\ndef kernel(x):\n"
+               "    print(x)  # graftlint: disable=TPU001\n"
+               "    return x\n")
+        result = lint_snippet(self.tmp, src, ["TPU001"])
+        self.assertEqual(set(codes(result)), {"GL001", "TPU001"})
+
+    def test_wrong_rule_does_not_suppress(self):
+        src = ("import jax\n\n@jax.jit\ndef kernel(x):\n"
+               "    print(x)  # graftlint: disable=TPU002 -- wrong code\n"
+               "    return x\n")
+        result = lint_snippet(self.tmp, src, ["TPU001"])
+        self.assertEqual(codes(result), ["TPU001"])
+
+    def test_stale_suppression_is_gl003(self):
+        # the suppressed violation was fixed but the comment stayed:
+        # its rule ran and absorbed nothing -> flag the dead comment
+        src = ("import jax\n\n@jax.jit\ndef kernel(x):\n"
+               "    return x  # graftlint: disable=TPU001 -- stale\n")
+        result = lint_snippet(self.tmp, src, ["TPU001"])
+        self.assertEqual(codes(result), ["GL003"])
+
+    def test_unselected_rule_suppression_not_stale(self):
+        # CONC002 didn't run: its suppression can't be judged stale
+        src = ("import jax\n\n@jax.jit\ndef kernel(x):\n"
+               "    return x  # graftlint: disable=CONC002 -- other\n")
+        result = lint_snippet(self.tmp, src, ["TPU001"])
+        self.assertEqual(codes(result), [])
+
+
+class TestBaseline(unittest.TestCase):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path):
+        self.tmp = tmp_path
+
+    def _one_finding(self):
+        path = self.tmp / "fixture.py"
+        path.write_text(TPU003_BAD)
+        return Project(str(self.tmp), overrides={"files": [str(path)]})
+
+    def test_round_trip(self):
+        project = self._one_finding()
+        result = run_rules(project, rules=["TPU003"])
+        self.assertEqual(codes(result), ["TPU003"])
+
+        baseline = self.tmp / "baseline.json"
+        write_baseline(str(baseline), result.findings)
+        # skeleton entries have empty reasons: still red, now as GL002
+        result2 = run_rules(self._one_finding(), rules=["TPU003"],
+                            baseline_path=str(baseline))
+        self.assertIn("GL002", codes(result2))
+
+        doc = json.loads(baseline.read_text())
+        for entry in doc["entries"]:
+            entry["reason"] = "accepted: fixture for the baseline test"
+        baseline.write_text(json.dumps(doc))
+        result3 = run_rules(self._one_finding(), rules=["TPU003"],
+                            baseline_path=str(baseline))
+        self.assertEqual(codes(result3), [])
+        self.assertEqual(len(result3.baselined), 1)
+        self.assertEqual(result3.exit_code, 0)
+
+    def test_fingerprint_survives_line_drift(self):
+        project = self._one_finding()
+        result = run_rules(project, rules=["TPU003"])
+        baseline = self.tmp / "baseline.json"
+        write_baseline(str(baseline), result.findings)
+        doc = json.loads(baseline.read_text())
+        for entry in doc["entries"]:
+            entry["reason"] = "accepted"
+        baseline.write_text(json.dumps(doc))
+        # shift the finding down three lines: fingerprint still matches
+        (self.tmp / "fixture.py").write_text("# pad\n# pad\n# pad\n"
+                                             + TPU003_BAD)
+        result2 = run_rules(self._one_finding(), rules=["TPU003"],
+                            baseline_path=str(baseline))
+        self.assertEqual(codes(result2), [])
+        self.assertEqual(len(result2.baselined), 1)
+
+    def test_duplicate_line_gets_distinct_fingerprint(self):
+        """A baseline entry accepts exactly ONE occurrence: a new
+        copy-paste of the identical violating line must still fail."""
+        two = ("import jax\n\n@jax.jit\ndef kernel(x):\n"
+               "    print(x)\n    print(x)\n    return x\n")
+        path = self.tmp / "fixture.py"
+        path.write_text(two)
+
+        def proj():
+            return Project(str(self.tmp),
+                           overrides={"files": [str(path)]})
+
+        result = run_rules(proj(), rules=["TPU001"])
+        self.assertEqual(len(result.findings), 2)
+        self.assertNotEqual(result.findings[0].fingerprint,
+                            result.findings[1].fingerprint)
+        baseline = self.tmp / "baseline.json"
+        write_baseline(str(baseline), result.findings)
+        doc = json.loads(baseline.read_text())
+        for entry in doc["entries"]:
+            entry["reason"] = "accepted pair"
+        baseline.write_text(json.dumps(doc))
+        result2 = run_rules(proj(), rules=["TPU001"],
+                            baseline_path=str(baseline))
+        self.assertEqual(codes(result2), [])
+        # a third identical line is NEW work, not covered by the pair
+        path.write_text(two.replace("    return x\n",
+                                    "    print(x)\n    return x\n"))
+        result3 = run_rules(proj(), rules=["TPU001"],
+                            baseline_path=str(baseline))
+        self.assertEqual(codes(result3), ["TPU001"])
+        self.assertEqual(len(result3.baselined), 2)
+
+    def test_missing_baseline_file(self):
+        fps, findings = load_baseline(str(self.tmp / "nope.json"))
+        self.assertEqual(fps, {})
+        self.assertEqual([f.rule for f in findings], ["GL002"])
+
+
+# ---------------------------------------------------------------------------
+# whole-repo smoke + CLI
+# ---------------------------------------------------------------------------
+
+class TestWholeRepo(unittest.TestCase):
+    def test_repo_lints_clean(self):
+        """The committed tree must carry zero actionable findings — the
+        same bar the check.yml lint-invariants job enforces."""
+        result = run_rules(Project(REPO_ROOT))
+        self.assertEqual(
+            [f.render() for f in result.findings], [],
+            msg="the tree must lint clean (fix or suppress with a "
+                "reason / baseline entry)")
+
+    def test_cli_json_exit0(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                          "graftlint.py"), "--json"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        self.assertEqual(proc.returncode, 0, msg=proc.stdout + proc.stderr)
+        doc = json.loads(proc.stdout)
+        self.assertEqual(doc["findings"], [])
+        self.assertEqual(doc["exit_code"], 0)
+
+    def test_cli_nonzero_with_rule_code(self):
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            fixture = os.path.join(tmp, "bad.py")
+            with open(fixture, "w") as f:
+                f.write(TPU003_BAD)
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO_ROOT, "scripts", "graftlint.py"),
+                 "--rules", "TPU003", "--json", fixture],
+                capture_output=True, text=True, cwd=REPO_ROOT)
+            self.assertEqual(proc.returncode, 1)
+            doc = json.loads(proc.stdout)
+            self.assertEqual([f["rule"] for f in doc["findings"]],
+                             ["TPU003"])
+
+    def test_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                          "graftlint.py"),
+             "--list-rules"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        self.assertEqual(proc.returncode, 0)
+        listed = set(proc.stdout.split())
+        for code in ("TPU001", "TPU002", "TPU003", "CONC001", "CONC002",
+                     "OBS001", "SIM001"):
+            self.assertIn(code, listed)
+
+
+if __name__ == "__main__":
+    unittest.main()
