@@ -186,6 +186,11 @@ def main():
             "cpu_pure_python_pairings_per_s":
                 round(pure, 2) if pure else None,
             "blst_equiv_baseline_per_s": BLST_EQUIV_CPU_RATE,
+            # r06 acceptance gate: the pairing stage must be a device
+            # number — zero host-oracle pairing calls on the happy path.
+            "device_pairing": provider._pairing_on_device,
+            "pairing_host_fallbacks": provider.pairing_host_fallbacks,
+            "g2_table_msm": provider._use_g2_tables,
         },
         vs_baseline=round(rate / BLST_EQUIV_CPU_RATE, 2))))
 

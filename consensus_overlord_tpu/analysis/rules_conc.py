@@ -49,6 +49,7 @@ DEVICE_MARKERS = {"device_get", "addressable_shards", "_kernels",
 #: breaker feedback, host-oracle fallback, metrics, or logging.
 MITIGATION_NAMES = {
     "_device_failed", "record_failure", "record_success",      # breaker
+    "_pairing_failed",  # breaker + pairing-fallback counter (r12 wrapper)
     "verify_signature", "aggregate_signatures",                # host oracle
     "_host_verify_all",
     "verify_aggregated_signature", "_update_pubkeys_host", "_cpu",

@@ -59,6 +59,7 @@ from .breaker import CircuitBreaker
 # that imports them shares the machine-wide persistent cache.
 _enable_compile_cache()
 from ..ops import bls12381_groups as dev
+from ..ops import pairing as pairing_ops
 from ..ops.curve import Point
 from . import bls12381 as oracle
 from .provider import CpuBlsCrypto, CryptoError
@@ -173,6 +174,75 @@ def verify_round_multi_fn(x, sign, inf, ok, wpacked, rows, gmask,
 
 _verify_round_multi = jax.jit(verify_round_multi_fn)
 
+# Device multi-pairing pad ladder: a frontier flush pairs one signature
+# aggregate with k hash groups (k ≤ _GROUP_SIZES[-1]), a QC check pairs
+# exactly 2 — two rungs keep the pairing kernel at two compiled shapes.
+_PAIR_SIZES = (2, 5)
+
+#: −G2 generator — the constant Q of every verify relation's signature
+#: pair e(Σ r_i·S_i, −g2): once as the host-oracle point tuple, once as
+#: device limbs for the pairing kernel.
+_NEG_G2_ORACLE = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+_NEG_G2_GEN_X = dev.FQ2.from_ints([_NEG_G2_ORACLE[0]])[0]
+_NEG_G2_GEN_Y = dev.FQ2.from_ints([_NEG_G2_ORACLE[1]])[0]
+
+
+# Device multi-pairing verdict: Π e(P_i, Q_i) == 1 over the pair axis
+# with ONE shared final exponentiation — two staged dispatches
+# (pair-rung-shaped Miller product + the rung-independent final-exp
+# verdict kernel; see ops/pairing.py for the compile-cost rationale).
+# This is the kernel pair that turns the `pairing` stage into a device
+# number and shrinks the post-MSM readback to the verdict bitmap.
+_multi_pairing = pairing_ops.multi_pairing_is_one_staged
+
+
+def verify_round_tab_fn(x, sign, inf, ok, wpacked, rows, tx, ty, tz):
+    """verify_round_fn with the G2 MSM served from PRECOMPUTED per-row
+    window tables (ops/curve.py msm_from_tables) instead of the
+    windowed ladder — the bench_g2_table_msm.py experiment promoted
+    behind the g2_table_msm knob.  Tables are rebuilt per reconfigure
+    (update_pubkeys), so the per-round path pays gathers + adds only."""
+    bits = dev.unpack_weight_bits(wpacked)
+    pt, valid = dev.g1_validate_batch(x, sign, inf, ok)
+    agg = dev.G1.msm_bits(pt, bits)
+    ax, ay, ainf = dev.G1.to_affine(agg)
+    vbits = bits * valid[..., None].astype(bits.dtype)
+    gagg = dev.G2.msm_from_tables(Point(tx, ty, tz), rows, vbits)
+    gx, gy, ginf = dev.G2.to_affine(gagg)
+    return (dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid,
+            dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0])
+
+
+_verify_round_tab = jax.jit(verify_round_tab_fn)
+
+
+def verify_round_multi_tab_fn(x, sign, inf, ok, wpacked, rows, gmask,
+                              tx, ty, tz):
+    """k-hash fused round with the per-group G2 MSMs from tables."""
+    bits = dev.unpack_weight_bits(wpacked)
+    pt, valid = dev.g1_validate_batch(x, sign, inf, ok)
+    agg = dev.G1.msm_bits(pt, bits)
+    ax, ay, ainf = dev.G1.to_affine(agg)
+    tab = Point(tx, ty, tz)
+    outs = [dev.FQ.strict(ax[0]), dev.FQ.strict(ay[0]), ainf[0], valid]
+    for g in range(gmask.shape[0]):
+        m = valid & gmask[g]
+        vbits = bits * m[..., None].astype(bits.dtype)
+        gagg = dev.G2.msm_from_tables(tab, rows, vbits)
+        gx, gy, ginf = dev.G2.to_affine(gagg)
+        outs += [dev.FQ.strict(gx[0]), dev.FQ.strict(gy[0]), ginf[0]]
+    return tuple(outs)
+
+
+_verify_round_multi_tab = jax.jit(verify_round_multi_tab_fn)
+
+
+@jax.jit
+def _build_g2_tables(px, py, pz):
+    """Per-reconfigure G2 window-table build over the padded device
+    pubkey cache (one 16-window × 16-digit multiple set per row)."""
+    return dev.G2.msm_table_build(Point(px, py, pz))
+
 
 @jax.jit
 def _g2_validate(x, sign, inf, ok):
@@ -215,6 +285,11 @@ class _SingleChipKernels:
     g2_sum_rows = staticmethod(lambda *a: _g2_sum_rows(*a))
     verify_round = staticmethod(lambda *a: _verify_round(*a))
     verify_round_multi = staticmethod(lambda *a: _verify_round_multi(*a))
+    verify_round_tab = staticmethod(lambda *a: _verify_round_tab(*a))
+    verify_round_multi_tab = staticmethod(
+        lambda *a: _verify_round_multi_tab(*a))
+    build_g2_tables = staticmethod(lambda *a: _build_g2_tables(*a))
+    multi_pairing = staticmethod(lambda *a: _multi_pairing(*a))
     lanes = 1
 
 
@@ -274,7 +349,9 @@ class TpuBlsCrypto:
     def __init__(self, private_key: int, common_ref: bytes = b"",
                  device_threshold: int = 32, mesh=None,
                  qc_device_threshold: Optional[int] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 device_pairing: Optional[bool] = None,
+                 g2_table_msm: Optional[bool] = None):
         """mesh: optional jax.sharding.Mesh — batches then shard across its
         devices through the parallel/sharded.py kernels (single-chip jits
         otherwise).  Pass parallel.make_mesh() to use every local device.
@@ -293,7 +370,23 @@ class TpuBlsCrypto:
         open breaker routes everything to the host oracle, with periodic
         half-open probes back onto the device.  Pass your own to tune
         thresholds; the default trips after 3 consecutive device
-        failures and probes every 5 s."""
+        failures and probes every 5 s.
+
+        device_pairing: run the Miller loop + shared final
+        exponentiation ON DEVICE (ops/pairing.py) so the post-MSM
+        readback shrinks to the verdict bitmap and the host oracle
+        becomes the fallback/cross-check twin.  None (default) reads
+        CONSENSUS_DEVICE_PAIRING (1/0/auto; auto = on for accelerator
+        backends, off on the CPU lane where the host oracle is cheaper
+        than the emulated tower).  Single-chip kernels only — mesh
+        providers keep the host pairing tail.
+
+        g2_table_msm: serve the verify relation's G2 MSM from
+        per-pubkey precomputed window tables rebuilt on reconfigure
+        (ops/curve.py msm_table_build — the bench_g2_table_msm.py
+        experiment promoted).  None reads CONSENSUS_G2_TABLE_MSM
+        (default off: tables cost ~240 KB of HBM per cached pubkey
+        row).  Single-chip kernels only."""
         self._cpu = CpuBlsCrypto(private_key, common_ref)
         self._common_ref = common_ref
         self._threshold = device_threshold
@@ -302,6 +395,36 @@ class TpuBlsCrypto:
                               else device_threshold)
         self._kernels = (_MeshKernels(mesh) if mesh is not None
                          and mesh.devices.size > 1 else _SingleChipKernels)
+        single_chip = getattr(self._kernels, "mesh", None) is None
+        if device_pairing is None:
+            mode = os.environ.get("CONSENSUS_DEVICE_PAIRING", "auto")
+            if mode == "auto":
+                device_pairing = jax.default_backend() != "cpu"
+            else:
+                device_pairing = mode not in ("0", "off", "false")
+        #: Device-resident pairing verdicts (see ctor docstring).  The
+        #: host oracle remains the fallback twin behind the breaker.
+        self._pairing_on_device = bool(device_pairing) and single_chip
+        #: CONSENSUS_PAIRING_CROSSCHECK=1: every device verdict is also
+        #: recomputed on the host oracle and mismatches are logged —
+        #: the soak/debug twin mode (costs the full aggregate readback
+        #: the device path otherwise skips).
+        self._pairing_crosscheck = (
+            os.environ.get("CONSENSUS_PAIRING_CROSSCHECK", "0") == "1")
+        #: Host-oracle pairing calls taken where the device pairing was
+        #: wanted but failed (dispatch/readback) — the acceptance gate:
+        #: 0 on the happy path.  Plain int (single writer per resolve;
+        #: mirrored into crypto_pairing_host_fallbacks_total when a
+        #: registry is bound).
+        self.pairing_host_fallbacks = 0
+        if g2_table_msm is None:
+            g2_table_msm = os.environ.get(
+                "CONSENSUS_G2_TABLE_MSM", "0") not in ("0", "off", "false")
+        self._use_g2_tables = bool(g2_table_msm) and single_chip
+        #: Device-resident per-row G2 window tables (g2_table_msm);
+        #: invalidated with _pk_dev on every cache append, rebuilt
+        #: eagerly at the end of update_pubkeys (the reconfigure point).
+        self._pk_tab: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
         # Validated-pubkey cache, stacked for vectorized batch gathers
         # (a per-row Python loop costs ~0.5 s per 1024-lane batch):
         # voter bytes → row index into the stacked coord arrays, or -1
@@ -370,7 +493,58 @@ class TpuBlsCrypto:
 
     def degraded_status(self) -> dict:
         """Breaker + fallback state for /statusz ("crypto" section)."""
-        return self.breaker.status()
+        doc = self.breaker.status()
+        doc["device_pairing"] = self._pairing_on_device
+        doc["pairing_host_fallbacks"] = self.pairing_host_fallbacks
+        doc["g2_table_msm"] = self._use_g2_tables
+        return doc
+
+    def _pairing_failed(self, exc: BaseException) -> None:
+        """One device pairing dispatch/readback failure: feed the
+        breaker like any device failure AND count the host-oracle
+        pairing fallback (the r06 acceptance gate watches this stay 0
+        on the happy path)."""
+        self._device_failed("pairing", exc)
+        self.pairing_host_fallbacks += 1
+        if self.metrics is not None:
+            self.metrics.pairing_host_fallbacks.inc()
+
+    def _dispatch_pairing(self, g1s, g2s):
+        """Dispatch the device multi-pairing verdict kernel over a
+        flush's pairs.  g1s: [(x, y, inf)] G1 strict-limb coords ((n,)
+        each, device or host); g2s: the matching [(x, y, inf)] Fq2
+        coords ((2, n)).  Pads to the _PAIR_SIZES ladder (masked lanes
+        contribute one) and returns the verdict device array — or None
+        after feeding the breaker if the dispatch failed, so callers
+        fall back to the host oracle twin."""
+        try:
+            self.breaker.raise_if_injected("pairing")
+            k = len(g1s)
+            size = next((s for s in _PAIR_SIZES if k <= s), k)
+            z1 = jnp.zeros((dev.FQ.n,), jnp.int32)
+            z2 = jnp.zeros((2, dev.FQ.n), jnp.int32)
+            pad = size - k
+            px = jnp.stack([jnp.asarray(g[0]) for g in g1s] + [z1] * pad)
+            py = jnp.stack([jnp.asarray(g[1]) for g in g1s] + [z1] * pad)
+            pinf = jnp.stack([jnp.asarray(g[2], bool) for g in g1s]
+                             + [jnp.asarray(True)] * pad)
+            qx = jnp.stack([jnp.asarray(g[0]) for g in g2s] + [z2] * pad)
+            qy = jnp.stack([jnp.asarray(g[1]) for g in g2s] + [z2] * pad)
+            qinf = jnp.stack([jnp.asarray(g[2], bool) for g in g2s]
+                             + [jnp.asarray(True)] * pad)
+            mask = np.arange(size) < k
+            with annotate("tpu_bls.pairing.dispatch"):
+                return self._kernels.multi_pairing(
+                    px, py, pinf, qx, qy, qinf, jnp.asarray(mask))
+        except Exception as e:  # noqa: BLE001 — device pairing dispatch failed
+            self._pairing_failed(e)
+            return None
+
+    @staticmethod
+    def _h_limbs(h_pt):
+        """Oracle G1 point → (x, y) strict limb arrays for the pairing
+        kernel's hash-side pairs."""
+        return dev.FQ.from_int(h_pt[0]), dev.FQ.from_int(h_pt[1])
 
     def _device_allowed(self, path: str) -> bool:
         """Ask the breaker; count the fallback when routed to host."""
@@ -573,10 +747,44 @@ class TpuBlsCrypto:
             return lambda: self._cpu.verify_aggregated_signature(
                 agg_sig, hash32, voters)
 
+        # Pipeline the verdict kernel right behind the pubkey sum (the
+        # batch paths' shape): the signature decompress + hash map are
+        # pure host work, so the pairing is in flight before resolve()
+        # ever blocks on the link — not serialized behind an aggregate
+        # readback.  An infinity aggregate skips its pair lane via
+        # q_inf, leaving the product at e(sig, −g2) ≠ 1, so the verdict
+        # agrees with the host path's "aggregate at infinity → False".
+        verdict_dev = None
+        sig_pt = None
+        if self._pairing_on_device:
+            try:
+                sig_pt = oracle.g1_decompress(agg_sig)
+            except ValueError:
+                sig_pt = None
+            if sig_pt is not None and not oracle.g1_in_subgroup(sig_pt):
+                sig_pt = None  # same rejection the host path applies
+            if sig_pt is not None:
+                h_pt = oracle.hash_to_g1(hash32, self._common_ref)
+                verdict_dev = self._dispatch_pairing(
+                    [(dev.FQ.from_int(sig_pt[0]),
+                      dev.FQ.from_int(sig_pt[1]), False),
+                     (*self._h_limbs(h_pt), False)],
+                    [(_NEG_G2_GEN_X, _NEG_G2_GEN_Y, False),
+                     (out[0], out[1], out[2])])
+
         def resolve() -> bool:
             t0 = time.perf_counter()
+            use_dev = self._pairing_on_device
+            agg = None
             try:
-                agg_pk = _affine_to_oracle_g2(*jax.device_get(out))
+                if use_dev and not self._pairing_crosscheck:
+                    # Device-pairing path: only the infinity flag is
+                    # read here; the aggregate stays on device for the
+                    # pairing kernel.
+                    ainf = bool(jax.device_get(out[2]))
+                else:
+                    agg = jax.device_get(out)
+                    ainf = bool(agg[2])
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_aggregated", e)
                 call.finish(ok=False)
@@ -586,19 +794,56 @@ class TpuBlsCrypto:
             call.observe("readback", time.perf_counter() - t0)
             t0 = time.perf_counter()
             try:
-                if agg_pk is None:
+                if ainf:
                     return False
-                try:
-                    sig_pt = oracle.g1_decompress(agg_sig)
-                except ValueError:
+                if sig_pt is None and use_dev:
+                    # Decompress/subgroup already failed at dispatch.
                     return False
-                if sig_pt is None or not oracle.g1_in_subgroup(sig_pt):
-                    return False
-                h = oracle.hash_to_g1(hash32, self._common_ref)
-                neg_g2 = (oracle.G2_GEN[0],
-                          oracle.fq2_neg(oracle.G2_GEN[1]))
-                result = oracle.multi_pairing_is_one([(sig_pt, neg_g2),
-                                                      (h, agg_pk)])
+                if not use_dev:
+                    try:
+                        host_sig = oracle.g1_decompress(agg_sig)
+                    except ValueError:
+                        return False
+                    if (host_sig is None
+                            or not oracle.g1_in_subgroup(host_sig)):
+                        return False
+                else:
+                    host_sig = sig_pt
+                result = None
+                if verdict_dev is not None:
+                    try:
+                        result = bool(jax.device_get(verdict_dev))
+                    except Exception as e:  # noqa: BLE001 — readback
+                        self._pairing_failed(e)
+                        result = None
+                if result is None:
+                    # Host-oracle pairing twin (device pairing off, or
+                    # its dispatch/readback failed above).
+                    if agg is None:
+                        try:
+                            agg = jax.device_get(out)
+                        except Exception as e:  # noqa: BLE001 — readback
+                            self._device_failed("verify_aggregated", e)
+                            return self._cpu.verify_aggregated_signature(
+                                agg_sig, hash32, voters)
+                    agg_pk = _affine_to_oracle_g2(*agg)
+                    if agg_pk is None:
+                        return False
+                    h = oracle.hash_to_g1(hash32, self._common_ref)
+                    result = oracle.multi_pairing_is_one(
+                        [(host_sig, _NEG_G2_ORACLE), (h, agg_pk)])
+                elif self._pairing_crosscheck and agg is not None:
+                    agg_pk = _affine_to_oracle_g2(*agg)
+                    h = oracle.hash_to_g1(hash32, self._common_ref)
+                    host_r = (False if agg_pk is None else
+                              oracle.multi_pairing_is_one(
+                                  [(host_sig, _NEG_G2_ORACLE),
+                                   (h, agg_pk)]))
+                    if host_r != result:
+                        logger.error(
+                            "device pairing verdict %s != host oracle %s "
+                            "(verify_aggregated, %d voters)", result,
+                            host_r, len(voters))
                 # Observed only when the pairing actually ran: garbage
                 # QCs returning early above must not flood the stage
                 # with near-zero samples and collapse its percentiles.
@@ -740,23 +985,50 @@ class TpuBlsCrypto:
     def _dispatch_single_hash(self, signatures, h, voters, n, size,
                               sx, ssign, sinf, sok, wpacked, rows,
                               pk_idx, pk_ok, call=NULL_CALL):
-        """Dispatch the fused kernel; return resolve() → List[bool]."""
+        """Dispatch the fused kernel (plus, when device pairing is on,
+        the multi-pairing verdict kernel pipelined right behind it);
+        return resolve() → List[bool]."""
         t0 = time.perf_counter()
-        pkx, pky, pkz = self._pk_device()
-        with annotate("tpu_bls.verify_round.dispatch"):
-            out = self._kernels.verify_round(
-                jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-                jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
-                pkx, pky, pkz)
+        if self._use_g2_tables:
+            tx, ty, tz = self._pk_tables()
+            with annotate("tpu_bls.verify_round.dispatch"):
+                out = self._kernels.verify_round_tab(
+                    jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+                    jnp.asarray(sok), jnp.asarray(wpacked),
+                    jnp.asarray(rows), tx, ty, tz)
+        else:
+            pkx, pky, pkz = self._pk_device()
+            with annotate("tpu_bls.verify_round.dispatch"):
+                out = self._kernels.verify_round(
+                    jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+                    jnp.asarray(sok), jnp.asarray(wpacked),
+                    jnp.asarray(rows), pkx, pky, pkz)
         self._observe_phase("dispatch", t0, call)
+        verdict_dev = None
+        if self._pairing_on_device:
+            # The verdict is on device before resolve() runs; only the
+            # validity bitmap + one bool cross the link afterwards.
+            verdict_dev = self._dispatch_pairing(
+                [(out[0], out[1], out[2]),
+                 (*self._h_limbs(oracle.hash_to_g1(h, self._common_ref)),
+                  False)],
+                [(_NEG_G2_GEN_X, _NEG_G2_GEN_Y, False),
+                 (out[4], out[5], out[6])])
 
         def resolve() -> List[bool]:
             # ONE device_get: separate per-output reads would each pay a
             # blocking D2H round-trip (~150 ms over a remote PJRT link) —
             # measured at 840 ms of the 1.1 s batch before this was fused.
+            # On the device-pairing path only the validity bitmap is
+            # fetched; the aggregates stay on device.
             t0 = time.perf_counter()
+            slim = verdict_dev is not None and not self._pairing_crosscheck
+            ax = ay = ainf = gx = gy = ginf = None
             try:
-                ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
+                if slim:
+                    valid = jax.device_get(out[3])
+                else:
+                    ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_batch", e)
                 call.finish(ok=False)
@@ -775,14 +1047,36 @@ class TpuBlsCrypto:
                 v = valid[:n] & pk_ok
                 if not v.any():
                     return [False] * n
-                agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
-                agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
-                h_pt = oracle.hash_to_g1(h, self._common_ref)
-                neg_g2 = (oracle.G2_GEN[0],
-                          oracle.fq2_neg(oracle.G2_GEN[1]))
-                paired = oracle.multi_pairing_is_one([(agg_sig, neg_g2),
-                                                      (h_pt, agg_pk)])
-                self._observe_phase("pairing", t0, call)
+                paired = None
+                if verdict_dev is not None:
+                    try:
+                        paired = bool(jax.device_get(verdict_dev))
+                        self._observe_phase("pairing", t0, call)
+                    except Exception as e:  # noqa: BLE001 — pairing readback
+                        self._pairing_failed(e)
+                        paired = None
+                if paired is None:
+                    # Host-oracle pairing twin: the only path when device
+                    # pairing is off, the exact fallback when it failed.
+                    if ax is None:
+                        try:
+                            (ax, ay, ainf, _, gx, gy,
+                             ginf) = jax.device_get(out)
+                        except Exception as e:  # noqa: BLE001 — readback
+                            self._device_failed("verify_batch", e)
+                            return [bool(v[i]) and self._verify_one_cached(
+                                        signatures[i], h, voters[i])
+                                    for i in range(n)]
+                    paired = self._host_pairing_single(ax, ay, ainf,
+                                                       gx, gy, ginf, h)
+                    self._observe_phase("pairing", t0, call)
+                elif self._pairing_crosscheck:
+                    host_p = self._host_pairing_single(ax, ay, ainf,
+                                                       gx, gy, ginf, h)
+                    if host_p != paired:
+                        logger.error(
+                            "device pairing verdict %s != host oracle %s "
+                            "(single-hash batch n=%d)", paired, host_p, n)
                 if paired:
                     return list(v)
                 # Batch relation failed: exact per-lane localization.
@@ -793,6 +1087,15 @@ class TpuBlsCrypto:
                 call.finish()
 
         return resolve
+
+    def _host_pairing_single(self, ax, ay, ainf, gx, gy, ginf, h) -> bool:
+        """The host-oracle pairing tail of a single-hash batch — the
+        pre-r06 mandatory last hop, now the fallback/cross-check twin."""
+        agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
+        agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
+        h_pt = oracle.hash_to_g1(h, self._common_ref)
+        return oracle.multi_pairing_is_one([(agg_sig, _NEG_G2_ORACLE),
+                                            (h_pt, agg_pk)])
 
     def _dispatch_multi_hash(self, signatures, voters, n,
                              groups: Dict[bytes, List[int]],
@@ -808,19 +1111,47 @@ class TpuBlsCrypto:
         for g, h in enumerate(ghashes):
             gmask[g, groups[h]] = True
         t0 = self._observe_phase("prep", t0, call)
-        pkx, pky, pkz = self._pk_device()
-        with annotate("tpu_bls.verify_round_multi.dispatch"):
-            out = self._kernels.verify_round_multi(
-                jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-                jnp.asarray(sok), jnp.asarray(wpacked), jnp.asarray(rows),
-                jnp.asarray(gmask), pkx, pky, pkz)
+        if self._use_g2_tables:
+            tx, ty, tz = self._pk_tables()
+            with annotate("tpu_bls.verify_round_multi.dispatch"):
+                out = self._kernels.verify_round_multi_tab(
+                    jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+                    jnp.asarray(sok), jnp.asarray(wpacked),
+                    jnp.asarray(rows), jnp.asarray(gmask), tx, ty, tz)
+        else:
+            pkx, pky, pkz = self._pk_device()
+            with annotate("tpu_bls.verify_round_multi.dispatch"):
+                out = self._kernels.verify_round_multi(
+                    jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
+                    jnp.asarray(sok), jnp.asarray(wpacked),
+                    jnp.asarray(rows), jnp.asarray(gmask), pkx, pky, pkz)
         self._observe_phase("dispatch", t0, call)
         lane_hashes = self._lane_hashes(groups, n)
+        verdict_dev = None
+        if self._pairing_on_device:
+            # One pair per hash group + the signature pair, one shared
+            # final exponentiation on device.  Groups whose aggregate
+            # lands at infinity (no valid lane voted on that hash) are
+            # skipped by the kernel's q_inf mask — the exact analog of
+            # the host path's "nothing to pair" continue.
+            g1s = [(out[0], out[1], out[2])]
+            g2s = [(_NEG_G2_GEN_X, _NEG_G2_GEN_Y, False)]
+            for g, h in enumerate(ghashes):
+                h_pt = oracle.hash_to_g1(h, self._common_ref)
+                g1s.append((*self._h_limbs(h_pt), False))
+                g2s.append(tuple(out[4 + 3 * g: 7 + 3 * g]))
+            verdict_dev = self._dispatch_pairing(g1s, g2s)
 
         def resolve() -> List[bool]:
             t0 = time.perf_counter()
+            slim = verdict_dev is not None and not self._pairing_crosscheck
+            flat = None
             try:
-                flat = jax.device_get(out)
+                if slim:
+                    valid = jax.device_get(out[3])
+                else:
+                    flat = jax.device_get(out)
+                    valid = flat[3]
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_batch", e)
                 call.finish(ok=False)
@@ -832,25 +1163,36 @@ class TpuBlsCrypto:
             self._shard_latencies(out[3])  # post-readback skew sample
             t0 = time.perf_counter()  # pairing excludes the sample's D2H
             try:
-                ax, ay, ainf, valid = flat[:4]
                 v = valid[:n] & pk_ok
                 if not v.any():
                     return [False] * n
-                agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
-                neg_g2 = (oracle.G2_GEN[0],
-                          oracle.fq2_neg(oracle.G2_GEN[1]))
-                pairs = [(agg_sig, neg_g2)]
-                for g, h in enumerate(ghashes):
-                    gx, gy, ginf = flat[4 + 3 * g: 7 + 3 * g]
-                    agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
-                    if agg_pk is None:
-                        # No valid lane voted on this hash — nothing to
-                        # pair.
-                        continue
-                    pairs.append((oracle.hash_to_g1(h, self._common_ref),
-                                  agg_pk))
-                paired = oracle.multi_pairing_is_one(pairs)
-                self._observe_phase("pairing", t0, call)
+                paired = None
+                if verdict_dev is not None:
+                    try:
+                        paired = bool(jax.device_get(verdict_dev))
+                        self._observe_phase("pairing", t0, call)
+                    except Exception as e:  # noqa: BLE001 — pairing readback
+                        self._pairing_failed(e)
+                        paired = None
+                if paired is None:
+                    if flat is None:
+                        try:
+                            flat = jax.device_get(out)
+                        except Exception as e:  # noqa: BLE001 — readback
+                            self._device_failed("verify_batch", e)
+                            return [bool(v[i]) and self._verify_one_cached(
+                                        signatures[i], lane_hashes[i],
+                                        voters[i])
+                                    for i in range(n)]
+                    paired = self._host_pairing_multi(flat, ghashes)
+                    self._observe_phase("pairing", t0, call)
+                elif self._pairing_crosscheck:
+                    host_p = self._host_pairing_multi(flat, ghashes)
+                    if host_p != paired:
+                        logger.error(
+                            "device pairing verdict %s != host oracle %s "
+                            "(%d-hash batch n=%d)", paired, host_p,
+                            len(ghashes), n)
                 if paired:
                     return list(v)
                 # Batch relation failed: exact per-lane localization.
@@ -861,6 +1203,21 @@ class TpuBlsCrypto:
                 call.finish()
 
         return resolve
+
+    def _host_pairing_multi(self, flat, ghashes) -> bool:
+        """Host-oracle pairing tail of a k-hash batch (fallback/cross-
+        check twin of the device multi-pairing)."""
+        ax, ay, ainf = flat[:3]
+        agg_sig = _affine_to_oracle_g1(ax, ay, ainf)
+        pairs = [(agg_sig, _NEG_G2_ORACLE)]
+        for g, h in enumerate(ghashes):
+            gx, gy, ginf = flat[4 + 3 * g: 7 + 3 * g]
+            agg_pk = _affine_to_oracle_g2(gx, gy, ginf)
+            if agg_pk is None:
+                # No valid lane voted on this hash — nothing to pair.
+                continue
+            pairs.append((oracle.hash_to_g1(h, self._common_ref), agg_pk))
+        return oracle.multi_pairing_is_one(pairs)
 
     def profile_sharded_stages(self, signatures, voters,
                                warm: bool = True) -> dict:
@@ -956,8 +1313,8 @@ class TpuBlsCrypto:
         if sig_pt is None or not oracle.g1_in_subgroup(sig_pt):
             return False
         h = oracle.hash_to_g1(hash32, self._common_ref)
-        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
-        return oracle.multi_pairing_is_one([(sig_pt, neg_g2), (h, pk_aff)])
+        return oracle.multi_pairing_is_one([(sig_pt, _NEG_G2_ORACLE),
+                                            (h, pk_aff)])
 
     def _ensure_pubkeys(self, voters: Sequence[bytes]) -> None:
         missing = []
@@ -979,6 +1336,15 @@ class TpuBlsCrypto:
         voters = [bytes(v) for v in voters]
         with self._pk_lock:
             self._update_pubkeys_locked(voters)
+        if self._use_g2_tables:
+            try:
+                # Rebuild the G2 window tables HERE, at the reconfigure
+                # point, so the first post-reconfigure verify pays
+                # gathers only.  A failed build stays lazy: the verify
+                # paths retry it inside their breaker-guarded dispatch.
+                self._pk_tables()
+            except Exception as e:  # noqa: BLE001 — device build failed
+                self._device_failed("update_pubkeys", e)
 
     def _update_pubkeys_locked(self, voters: List[bytes]) -> None:
         voters = [v for v in voters if v not in self._pk_index]
@@ -1031,6 +1397,7 @@ class TpuBlsCrypto:
         for i, v in enumerate(voters):
             self._pk_index[v] = base + i if valid[i] else -1
         self._pk_dev = None  # device copy is stale; re-upload lazily
+        self._pk_tab = None  # window tables too (g2_table_msm)
 
     def _update_pubkeys_host(self, voters: List[bytes]) -> None:
         """Host-oracle twin of the device validation path: decompress +
@@ -1067,18 +1434,39 @@ class TpuBlsCrypto:
         update_pubkeys grew the host arrays — a per-reconfigure cost;
         per batch only the (B,) row indices travel over the link."""
         with self._pk_lock:
-            if self._pk_dev is None:
-                rows = max(self._pk_px.shape[0], 1)
-                cap = _pk_capacity(rows)
-                px = np.zeros((cap, 2, dev.FQ.n), np.int32)
-                py = np.zeros((cap, 2, dev.FQ.n), np.int32)
-                pz = np.zeros((cap, 2, dev.FQ.n), np.int32)
-                px[:self._pk_px.shape[0]] = self._pk_px
-                py[:self._pk_py.shape[0]] = self._pk_py
-                pz[:self._pk_pz.shape[0]] = self._pk_pz
-                self._pk_dev = (jnp.asarray(px), jnp.asarray(py),
-                                jnp.asarray(pz))
-            return self._pk_dev
+            return self._pk_device_locked()
+
+    def _pk_device_locked(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Body of _pk_device — caller holds self._pk_lock."""
+        if self._pk_dev is None:
+            rows = max(self._pk_px.shape[0], 1)
+            cap = _pk_capacity(rows)
+            px = np.zeros((cap, 2, dev.FQ.n), np.int32)
+            py = np.zeros((cap, 2, dev.FQ.n), np.int32)
+            pz = np.zeros((cap, 2, dev.FQ.n), np.int32)
+            px[:self._pk_px.shape[0]] = self._pk_px
+            py[:self._pk_py.shape[0]] = self._pk_py
+            pz[:self._pk_pz.shape[0]] = self._pk_pz
+            self._pk_dev = (jnp.asarray(px), jnp.asarray(py),
+                            jnp.asarray(pz))
+        return self._pk_dev
+
+    def _pk_tables(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Device-resident G2 window tables over the padded pubkey
+        cache (g2_table_msm).  Rebuilt only after update_pubkeys grew
+        the host arrays — a per-reconfigure cost, like _pk_device's
+        upload, but ~256x the HBM (16 windows × 16 digits per row).
+        The device fetch and the staleness check share ONE critical
+        section: fetching outside the lock would let a concurrent
+        update_pubkeys invalidate both caches between the two steps and
+        this thread then cache tables built from the pre-reconfigure
+        upload as fresh."""
+        with self._pk_lock:
+            if self._pk_tab is None:
+                px, py, pz = self._pk_device_locked()
+                tab = self._kernels.build_g2_tables(px, py, pz)
+                self._pk_tab = (tab.x, tab.y, tab.z)
+            return self._pk_tab
 
     def _pk_rows_of(self, voters: Sequence[bytes]) -> np.ndarray:
         """Row indices into the stacked pubkey arrays; bad keys = -1."""

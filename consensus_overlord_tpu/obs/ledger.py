@@ -480,7 +480,11 @@ def trend(records: Sequence[BenchRecord],
             "occupancy": rec.occupancy,
             "stages": len(rec.stages),
         }
-        if prev is not None and prev.value and rec.value is not None:
+        if (prev is not None and prev.value and rec.value is not None
+                and comparable(prev, rec)):
+            # A metric change between neighbors (a glob that swept the
+            # whole bench-ladder family) yields nonsense deltas — show
+            # the rung, skip the comparison (same rule as plateaus).
             row["delta_pct"] = round(
                 (rec.value - prev.value) / abs(prev.value) * 100, 2)
         # Environment drift is the first question a surprising delta

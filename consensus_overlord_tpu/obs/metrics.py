@@ -56,6 +56,9 @@ multiple nodes can live in one test process):
   wal        wal_append_ms, wal_fsync_ms, wal_corruptions_total
   degraded   crypto_device_failures_total{path},
              crypto_host_fallbacks_total{path},
+             crypto_pairing_host_fallbacks_total — pairing checks that
+             fell back to the host oracle after a device pairing
+             failure (0 on the happy path, the r06 acceptance gate),
              crypto_breaker_transitions_total{to}, crypto_breaker_open
              — the device circuit breaker + host-oracle fallback
              (crypto/breaker.py; frontier re-verify)
@@ -268,6 +271,12 @@ class Metrics:
             "crypto_host_fallbacks_total",
             "Batches re-routed to the host oracle (degraded mode), by "
             "provider path", ["path"], registry=self.registry)
+        self.pairing_host_fallbacks = Counter(
+            "crypto_pairing_host_fallbacks_total",
+            "Pairing checks that fell back to the host oracle after a "
+            "device pairing dispatch/readback failure (0 on the happy "
+            "path once the pairing is device-resident)",
+            registry=self.registry)
         self.breaker_transitions = Counter(
             "crypto_breaker_transitions_total",
             "Device circuit-breaker state transitions", ["to"],

@@ -291,6 +291,70 @@ class CurveOps:
         field muls per step than doubling-by-add)."""
         return self.tree_sum(self.scalar_mul_bits(p, bits))
 
+    def msm_table_build(self, p: Point, windows: int = 16,
+                        digits: int = 16) -> Point:
+        """(R, ...) base points → (R, windows, digits, ...) multiples
+        T[r, j, d] = d · (2^w)^j · P_r with j=0 the MOST significant
+        window (matching unpack_weight_bits' MSB-first bit order) and
+        the d=0 row the identity — so msm_from_tables lanes whose
+        scalar was masked to 0 gather pure identities.  Build cost
+        (~w·windows doublings + digits·windows adds, batched over keys)
+        is paid once per reconfigure, not per round: the promotion of
+        the bench_g2_table_msm.py experiment into the production MSM
+        over the cached validator pubkeys."""
+        w = 1
+        while (1 << w) < digits:
+            w += 1
+
+        def window_step(pt, _):
+            nxt = pt
+            for _ in range(w):
+                nxt = self.dbl(nxt)
+            return nxt, pt  # collect (2^w)^j·P for j = 0.. (LS first)
+
+        _, per_win = lax.scan(window_step, p, None, length=windows)
+        # (windows, R, ...) LS-window first → flip to MS-window first.
+        per_win = Point(per_win.x[::-1], per_win.y[::-1], per_win.z[::-1])
+
+        def digit_step(acc, _):
+            nxt = self.add(acc, per_win)
+            return nxt, acc  # collect d·(2^w)^j·P for d = 0..
+
+        inf = self.infinity_like(per_win.x)
+        _, tab = lax.scan(digit_step, inf, None, length=digits)
+        # (digits, windows, R, ...) → (R, windows, digits, ...)
+        perm = (2, 1, 0) + tuple(range(3, tab.x.ndim))
+        return Point(tab.x.transpose(perm), tab.y.transpose(perm),
+                     tab.z.transpose(perm))
+
+    def msm_from_tables(self, tab: Point, rows: Array, bits: Array) -> Point:
+        """Σ_i k_i·P_{rows_i} from msm_table_build output: per lane,
+        gather one point per window by (row, window, digit) and
+        tree-reduce — the 64 accumulator doublings of the ladder (its
+        dominant term) vanish from the per-round path.  `bits` is the
+        (B, nbits) MSB-first scalar bit array of msm_bits; masked lanes
+        (all-zero scalars) contribute only identity gathers."""
+        windows = tab.x.shape[1]
+        digits_n = tab.x.shape[2]
+        w = 1
+        while (1 << w) < digits_n:
+            w += 1
+        weights = jnp.asarray([1 << (w - 1 - i) for i in range(w)],
+                              jnp.int32)
+        digits = (bits.reshape(bits.shape[0], windows, w)
+                  * weights).sum(-1)                      # (B, windows)
+        r = rows[:, None].astype(jnp.int32)
+        j = jnp.arange(windows, dtype=jnp.int32)[None, :]
+        p = Point(tab.x[r, j, digits], tab.y[r, j, digits],
+                  tab.z[r, j, digits])                    # (B, windows, ...)
+        width = windows
+        while width > 1:
+            half = width // 2
+            p = self.add(Point(p.x[:, :half], p.y[:, :half], p.z[:, :half]),
+                         Point(p.x[:, half:], p.y[:, half:], p.z[:, half:]))
+            width = half
+        return self.tree_sum(Point(p.x[:, 0], p.y[:, 0], p.z[:, 0]))
+
     # -- reductions ----------------------------------------------------------
 
     def tree_sum(self, p: Point) -> Point:

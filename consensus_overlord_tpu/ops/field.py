@@ -113,6 +113,8 @@ class FieldSpec:
         # jit it once so host-side canonicalization is one dispatch.
         self._strict_jit = jax.jit(self._strict_impl)
 
+        self._plan_memo: dict = {}
+
         # Dry-run the mul/add/sub reduction plans once so an unreducible
         # layout fails at spec construction, not first trace.
         for bounds in (self._conv_bounds(),
@@ -150,7 +152,14 @@ class FieldSpec:
         """Static reduction plan for the given per-position bounds: a list
         of ('fold', k) / ('carry', extend) steps ending with width n and all
         bounds ≤ loose_max.  Pure bound arithmetic — raises if no safe plan
-        exists."""
+        exists.  Memoized on the bound tuple: a deep kernel (the pairing
+        tower traces hundreds of muls) re-plans the same handful of bound
+        shapes at every call site, and the planning loop is the dominant
+        trace-time cost."""
+        key = tuple(bounds)
+        cached = self._plan_memo.get(key)
+        if cached is not None:
+            return cached
         b, n, mask = self.b, self.n, self.mask
         steps: List[Tuple[str, int]] = []
         for _ in range(256):
@@ -158,6 +167,7 @@ class FieldSpec:
                 if len(bounds) < n:
                     steps.append(("pad", n - len(bounds)))
                     bounds += [0] * (n - len(bounds))
+                self._plan_memo[key] = steps
                 return steps
             m = len(bounds)
             if m > n:

@@ -1,0 +1,212 @@
+"""Batched Fq12 = Fq6[w]/(w² − v) arithmetic — the top of the BLS12-381
+tower — with the cyclotomic squaring and the |x|-power chain the final
+exponentiation needs.
+
+Elements are (..., 2, 3, 2, n) int32 limb arrays: Fq12 component axis
+(1, w), then the Fq6 layout of ops/fq6.py.  The Frobenius twist
+constants γ_k = ξ^(k·(p−1)/6) are baked at import from the host tower
+(crypto/bls12381.py), which stays the correctness oracle for every op
+here (tests/test_pairing.py).
+
+Tower recap (host crypto/bls12381.py):  Fq2 = Fq[u]/(u²+1);
+Fq6 = Fq2[v]/(v³ − ξ), ξ = 1+u;  Fq12 = Fq6[w]/(w² − v), so w⁶ = ξ.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto import bls12381 as oracle
+from .field import Array
+from .fq6 import Fq6Ops
+
+
+class Fq12Ops:
+    """Quadratic extension ops over Fq6 with w² = v."""
+
+    def __init__(self, fq6: Fq6Ops):
+        self.fq6 = fq6
+        self.fq2 = fq6.fq2
+        self.fq = fq6.fq
+        # Frobenius twist constants for the (1, v, v², w, vw, v²w) basis:
+        # γ^k = ξ^(k·(p−1)/6) for k = 1..5, exact host ints → limbs.
+        if oracle._GAMMA is None:
+            oracle.fq12_frobenius(oracle.FQ12_ONE)  # builds the table
+        self._gamma = [self.fq2.from_ints([g])[0] for g in oracle._GAMMA]
+
+    # components -------------------------------------------------------------
+
+    @staticmethod
+    def c0(x: Array) -> Array:
+        return x[..., 0, :, :, :]
+
+    @staticmethod
+    def c1(x: Array) -> Array:
+        return x[..., 1, :, :, :]
+
+    @staticmethod
+    def build(c0: Array, c1: Array) -> Array:
+        return jnp.stack([c0, c1], axis=-4)
+
+    def one(self) -> Array:
+        return self.build(self.fq6.one(), self.fq6.zero())
+
+    def one_like(self, x: Array) -> Array:
+        return jnp.broadcast_to(self.one(), x.shape).astype(jnp.int32)
+
+    def from_int_pairs(self, vals) -> Array:
+        """[(fq6_triple, fq6_triple), ...] host tuples → (len, 2,3,2,n)."""
+        import numpy as np
+        rows = []
+        for a, b in vals:
+            rows.append(np.stack([
+                np.asarray(self.fq6.from_int_triples([a])[0]),
+                np.asarray(self.fq6.from_int_triples([b])[0])]))
+        return jnp.asarray(np.stack(rows))
+
+    def to_int_pairs(self, x: Array):
+        a = self.fq6.to_int_triples(self.c0(x))
+        b = self.fq6.to_int_triples(self.c1(x))
+        return list(zip(a, b))
+
+    # arithmetic -------------------------------------------------------------
+
+    def add(self, x: Array, y: Array) -> Array:
+        return self.build(self.fq6.add(self.c0(x), self.c0(y)),
+                          self.fq6.add(self.c1(x), self.c1(y)))
+
+    def mul(self, x: Array, y: Array) -> Array:
+        # Karatsuba over Fq6 with w² = v (host fq12_mul): 3 Fq6 muls.
+        f = self.fq6
+        a0, a1 = self.c0(x), self.c1(x)
+        b0, b1 = self.c0(y), self.c1(y)
+        t0 = f.mul(a0, b0)
+        t1 = f.mul(a1, b1)
+        c0 = f.add(t0, f.mul_v(t1))
+        c1 = f.sub(f.sub(f.mul(f.add(a0, a1), f.add(b0, b1)), t0), t1)
+        return self.build(c0, c1)
+
+    def sq(self, x: Array) -> Array:
+        # Complex squaring: (a0 + a1w)² = (a0 + a1)(a0 + v·a1) − t − vt
+        # with t = a0·a1 — 2 Fq6 muls vs mul's 3.
+        f = self.fq6
+        a0, a1 = self.c0(x), self.c1(x)
+        t = f.mul(a0, a1)
+        c0 = f.sub(f.sub(f.mul(f.add(a0, a1), f.add(a0, f.mul_v(a1))), t),
+                   f.mul_v(t))
+        return self.build(c0, f.add(t, t))
+
+    def conj(self, x: Array) -> Array:
+        """x^(p⁶): negate the w-odd half.  For cyclotomic elements this
+        is the inverse (unitary)."""
+        return self.build(self.c0(x), self.fq6.neg(self.c1(x)))
+
+    def inv(self, x: Array) -> Array:
+        f = self.fq6
+        a0, a1 = self.c0(x), self.c1(x)
+        t = f.inv(f.sub(f.sq(a0), f.mul_v(f.sq(a1))))
+        return self.build(f.mul(a0, t), f.neg(f.mul(a1, t)))
+
+    def mul_by_014(self, x: Array, a0: Array, a1: Array,
+                   a4: Array) -> Array:
+        """x · g where g is sparse in the (1, v, v², w, vw, v²w) basis:
+        g = a0 + a1·v + a4·vw — exactly the shape of a Miller-loop line
+        evaluated at a twisted G1 point (ops/pairing.py).  13 Fq2 muls
+        vs the dense mul's 18."""
+        f6, f2 = self.fq6, self.fq2
+        x0, x1 = self.c0(x), self.c1(x)
+        t0 = f6.mul_by_01(x0, a0, a1)
+        t1 = f6.mul_by_1(x1, a4)
+        c0 = f6.add(t0, f6.mul_v(t1))
+        c1 = f6.sub(f6.sub(
+            f6.mul_by_01(f6.add(x0, x1), a0, f2.add(a1, a4)), t0), t1)
+        return self.build(c0, c1)
+
+    # cyclotomic subgroup ----------------------------------------------------
+
+    def cyc_sq(self, x: Array) -> Array:
+        """Squaring for UNITARY elements (x·conj(x) = 1, true of
+        everything after the final exponentiation's easy part): with
+        x = a + bw, a² − v·b² = 1, so x² = (2a² − 1) + 2ab·w — one Fq6
+        square + one Fq6 mul vs the generic square's two muls, and the
+        workhorse of the x-power chain (hundreds of squarings per final
+        exponentiation)."""
+        f = self.fq6
+        a, b = self.c0(x), self.c1(x)
+        a2 = f.sq(a)
+        c0 = f.sub(f.add(a2, a2), self.fq6.one())
+        ab = f.mul(a, b)
+        return self.build(c0, f.add(ab, ab))
+
+    def cyc_pow_abs(self, x: Array, e: int) -> Array:
+        """x^e for a static e ≥ 1, x cyclotomic: MSB-first square-and-
+        multiply under one lax.scan (branchless select), cyclotomic
+        squarings.  Negative exponents: pass conj(x) (= x⁻¹)."""
+        assert e >= 1
+        bits = jnp.asarray([int(c) for c in bin(e)[3:]], jnp.int32)
+        if bits.shape[0] == 0:
+            return x
+
+        def step(acc, bit):
+            acc = self.cyc_sq(acc)
+            acc = self.where(bit.astype(bool), self.mul(acc, x), acc)
+            return acc, None
+
+        acc, _ = lax.scan(step, x, bits)
+        return acc
+
+    # Frobenius --------------------------------------------------------------
+
+    def frobenius(self, x: Array) -> Array:
+        """x^p: conjugate every Fq2 coefficient, twist by the γ table
+        (host fq12_frobenius)."""
+        f2, f6 = self.fq2, self.fq6
+        g = self._gamma
+        a, b = self.c0(x), self.c1(x)
+        a0, a1, a2 = f6.c(a, 0), f6.c(a, 1), f6.c(a, 2)
+        b0, b1, b2 = f6.c(b, 0), f6.c(b, 1), f6.c(b, 2)
+        return self.build(
+            f6.build(f2.conj(a0),
+                     f2.mul(f2.conj(a1), g[1]),
+                     f2.mul(f2.conj(a2), g[3])),
+            f6.build(f2.mul(f2.conj(b0), g[0]),
+                     f2.mul(f2.conj(b1), g[2]),
+                     f2.mul(f2.conj(b2), g[4])))
+
+    # final exponentiation ---------------------------------------------------
+
+    def final_exponentiation(self, f: Array) -> Array:
+        """f^(3·(p¹²−1)/r) — the host fast chain
+        (crypto/bls12381.py final_exponentiation) on device: easy part
+        by conjugation + one inversion + two Frobenius maps, hard part
+        as the BLS12 (x−1)²·(x+p)·(x²+p²−1)+3 decomposition over
+        cyclotomic |x|-power chains.  Outputs match the host chain
+        bit-for-bit (the shared CUBE of the standard pairing; see the
+        host docstring for why no equality check can tell)."""
+        x_abs = oracle.X_ABS
+        m = self.mul(self.conj(f), self.inv(f))        # f^(p⁶−1)
+        m = self.mul(self.frobenius(self.frobenius(m)), m)  # ^(p²+1)
+        # Hard part; m is cyclotomic now, x = −|x| so x−1 = −(|x|+1).
+        t0 = self.cyc_pow_abs(self.conj(m), x_abs + 1)       # m^(x−1)
+        t1 = self.cyc_pow_abs(self.conj(t0), x_abs + 1)      # ^(x−1)²
+        t2 = self.mul(self.cyc_pow_abs(self.conj(t1), x_abs),
+                      self.frobenius(t1))                    # ^(x+p)
+        u = self.cyc_pow_abs(self.conj(t2), x_abs)
+        t3 = self.mul(
+            self.mul(self.cyc_pow_abs(self.conj(u), x_abs),
+                     self.frobenius(self.frobenius(t2))),
+            self.conj(t2))                                   # ^(x²+p²−1)
+        return self.mul(t3, self.mul(self.cyc_sq(m), m))     # · m³
+
+    # predicates / selection -------------------------------------------------
+
+    def is_one(self, x: Array) -> Array:
+        return self.eq(x, self.one_like(x))
+
+    def eq(self, x: Array, y: Array) -> Array:
+        return (self.fq6.eq(self.c0(x), self.c0(y)) &
+                self.fq6.eq(self.c1(x), self.c1(y)))
+
+    def where(self, mask: Array, x: Array, y: Array) -> Array:
+        return jnp.where(mask[..., None, None, None, None], x, y)

@@ -6,7 +6,11 @@ stays jit/vmap/shard_map-safe.  All control flow is branchless (selects),
 including the square root, so the ops vectorize across TPU lanes.
 
 This is the device analog of the host tower in crypto/bls12381.py (itself
-replacing the Fq2 arithmetic inside blst, reference src/consensus.rs:336).
+replacing the Fq2 arithmetic inside blst, reference src/consensus.rs:336),
+and the first rung of the full device extension tower: ops/fq6.py stacks
+the cubic step (v³ = 1+u) on these ops, ops/fq12.py the quadratic top
+(w² = v), and ops/pairing.py drives all three through the batched
+optimal-ate Miller loop + shared final exponentiation.
 """
 
 from __future__ import annotations

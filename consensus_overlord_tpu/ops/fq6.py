@@ -1,0 +1,162 @@
+"""Batched Fq6 = Fq2[v]/(v³ − ξ) arithmetic, ξ = 1 + u — the middle rung
+of the BLS12-381 extension tower on the int32-limb machinery.
+
+Elements are (..., 3, 2, n) int32 limb arrays — Fq6 component axis, then
+the Fq2 layout of fq2.py — so everything broadcasts over arbitrary
+leading batch dimensions and stays jit/vmap/shard_map-safe.  All control
+flow is branchless, matching the tower discipline of ops/fq2.py.
+
+This is the device analog of the host fq6_* functions in
+crypto/bls12381.py; ops/fq12.py stacks the quadratic step on top and
+ops/pairing.py drives both through the optimal-ate Miller loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .field import Array
+from .fq2 import Fq2Ops
+
+
+class Fq6Ops:
+    """Cubic extension ops over Fq2 with v³ = ξ = 1 + u (the BLS12-381
+    sextic-twist non-residue)."""
+
+    def __init__(self, fq2: Fq2Ops):
+        self.fq2 = fq2
+        self.fq = fq2.fq
+
+    # components -------------------------------------------------------------
+
+    @staticmethod
+    def c(x: Array, i: int) -> Array:
+        return x[..., i, :, :]
+
+    @staticmethod
+    def build(c0: Array, c1: Array, c2: Array) -> Array:
+        return jnp.stack([c0, c1, c2], axis=-3)
+
+    def one(self) -> Array:
+        z = self.fq2.zero()
+        return self.build(self.fq2.one(), z, z)
+
+    def zero(self) -> Array:
+        z = self.fq2.zero()
+        return self.build(z, z, z)
+
+    def from_int_triples(self, triples) -> Array:
+        """[( (a0,a1), (b0,b1), (c0,c1) ), ...] → (len, 3, 2, n)."""
+        import numpy as np
+        rows = []
+        for t in triples:
+            rows.append(np.stack([np.asarray(self.fq2.from_ints([p])[0])
+                                  for p in t]))
+        return jnp.asarray(np.stack(rows))
+
+    def to_int_triples(self, x: Array):
+        c0 = self.fq2.to_int_pairs(self.c(x, 0))
+        c1 = self.fq2.to_int_pairs(self.c(x, 1))
+        c2 = self.fq2.to_int_pairs(self.c(x, 2))
+        return list(zip(c0, c1, c2))
+
+    # arithmetic -------------------------------------------------------------
+
+    def add(self, x: Array, y: Array) -> Array:
+        f = self.fq2
+        return self.build(f.add(self.c(x, 0), self.c(y, 0)),
+                          f.add(self.c(x, 1), self.c(y, 1)),
+                          f.add(self.c(x, 2), self.c(y, 2)))
+
+    def sub(self, x: Array, y: Array) -> Array:
+        f = self.fq2
+        return self.build(f.sub(self.c(x, 0), self.c(y, 0)),
+                          f.sub(self.c(x, 1), self.c(y, 1)),
+                          f.sub(self.c(x, 2), self.c(y, 2)))
+
+    def neg(self, x: Array) -> Array:
+        f = self.fq2
+        return self.build(f.neg(self.c(x, 0)), f.neg(self.c(x, 1)),
+                          f.neg(self.c(x, 2)))
+
+    def mul_xi(self, x: Array) -> Array:
+        """Component-wise multiply by ξ = 1 + u (fq2.mul_small_xi k=1)."""
+        f = self.fq2
+        return self.build(f.mul_small_xi(self.c(x, 0), 1),
+                          f.mul_small_xi(self.c(x, 1), 1),
+                          f.mul_small_xi(self.c(x, 2), 1))
+
+    def mul(self, x: Array, y: Array) -> Array:
+        # Toom-style interpolation, the host fq6_mul schedule: 6 Fq2 muls.
+        f = self.fq2
+        a0, a1, a2 = self.c(x, 0), self.c(x, 1), self.c(x, 2)
+        b0, b1, b2 = self.c(y, 0), self.c(y, 1), self.c(y, 2)
+        t0 = f.mul(a0, b0)
+        t1 = f.mul(a1, b1)
+        t2 = f.mul(a2, b2)
+        c0 = f.add(t0, f.mul_small_xi(
+            f.sub(f.sub(f.mul(f.add(a1, a2), f.add(b1, b2)), t1), t2), 1))
+        c1 = f.add(
+            f.sub(f.sub(f.mul(f.add(a0, a1), f.add(b0, b1)), t0), t1),
+            f.mul_small_xi(t2, 1))
+        c2 = f.add(
+            f.sub(f.sub(f.mul(f.add(a0, a2), f.add(b0, b2)), t0), t2), t1)
+        return self.build(c0, c1, c2)
+
+    def sq(self, x: Array) -> Array:
+        return self.mul(x, x)
+
+    def mul_v(self, x: Array) -> Array:
+        """Multiply by v: (c0, c1, c2) → (ξ·c2, c0, c1)."""
+        return self.build(self.fq2.mul_small_xi(self.c(x, 2), 1),
+                          self.c(x, 0), self.c(x, 1))
+
+    def mul_by_01(self, x: Array, b0: Array, b1: Array) -> Array:
+        """x · (b0 + b1·v) — the sparse multiply the pairing's line
+        evaluations need (5 Fq2 muls instead of 6)."""
+        f = self.fq2
+        a0, a1, a2 = self.c(x, 0), self.c(x, 1), self.c(x, 2)
+        t0 = f.mul(a0, b0)
+        t1 = f.mul(a1, b1)
+        t2 = f.sub(f.sub(f.mul(f.add(a0, a1), f.add(b0, b1)), t0), t1)
+        return self.build(f.add(t0, f.mul_small_xi(f.mul(a2, b1), 1)),
+                          t2,
+                          f.add(t1, f.mul(a2, b0)))
+
+    def mul_by_1(self, x: Array, b1: Array) -> Array:
+        """x · (b1·v) — 3 Fq2 muls."""
+        f = self.fq2
+        return self.build(
+            f.mul_small_xi(f.mul(self.c(x, 2), b1), 1),
+            f.mul(self.c(x, 0), b1),
+            f.mul(self.c(x, 1), b1))
+
+    def inv(self, x: Array) -> Array:
+        # Host fq6_inv: c-matrix adjugate over the norm; inv(0) = 0
+        # (fq2.inv(0) = 0 keeps the math total).
+        f = self.fq2
+        a0, a1, a2 = self.c(x, 0), self.c(x, 1), self.c(x, 2)
+        c0 = f.sub(f.sq(a0), f.mul_small_xi(f.mul(a1, a2), 1))
+        c1 = f.sub(f.mul_small_xi(f.sq(a2), 1), f.mul(a0, a1))
+        c2 = f.sub(f.sq(a1), f.mul(a0, a2))
+        t = f.add(f.mul(a0, c0),
+                  f.mul_small_xi(f.add(f.mul(a2, c1), f.mul(a1, c2)), 1))
+        t_inv = f.inv(t)
+        return self.build(f.mul(c0, t_inv), f.mul(c1, t_inv),
+                          f.mul(c2, t_inv))
+
+    # predicates / selection -------------------------------------------------
+
+    def is_zero(self, x: Array) -> Array:
+        f = self.fq2
+        return (f.is_zero(self.c(x, 0)) & f.is_zero(self.c(x, 1)) &
+                f.is_zero(self.c(x, 2)))
+
+    def eq(self, x: Array, y: Array) -> Array:
+        f = self.fq2
+        return (f.eq(self.c(x, 0), self.c(y, 0)) &
+                f.eq(self.c(x, 1), self.c(y, 1)) &
+                f.eq(self.c(x, 2), self.c(y, 2)))
+
+    def where(self, mask: Array, x: Array, y: Array) -> Array:
+        return jnp.where(mask[..., None, None, None], x, y)

@@ -1,0 +1,254 @@
+"""Device-resident optimal-ate pairing: batched Miller loop + one shared
+final exponentiation per multi-pairing call.
+
+This closes the last host round-trip of the verify pipeline (SURVEY.md
+§7(b)): until now every frontier flush finished with
+`oracle.multi_pairing_is_one(...)` through csrc/bls381.c on the host.
+Here the whole relation — Miller loops, product accumulation, final
+exponentiation, the == 1 test — runs as one jit on the int32-limb tower
+(ops/fq6.py / ops/fq12.py over ops/fq2.py), and only the verdict boolean
+crosses the link.
+
+Formulation (the standard twist trick, branchless):
+
+* The Miller loop runs ON THE TWIST E': y² = x³ + 4ξ with the G2
+  accumulator in homogeneous projective Fq2 coordinates — no inversions
+  anywhere in the loop.  Instead of untwisting Q (host
+  crypto/bls12381.py `untwist`, full-Fq12 point arithmetic), the G1
+  point is twisted UP: P = (x_P, y_P) ↦ (x_P·w², y_P·w³).  A line
+  through R = (X:Y:Z) evaluated there is, after clearing Fq2-valued
+  denominators (killed by the final exponentiation — they live in a
+  proper subfield):
+
+    doubling:  (3X³ − 2Y²Z)  +  (−3X²Z·x_P)·v  +  (2YZ²·y_P)·vw
+    addition:  (θ·x_Q − μ·y_Q) + (−θ·x_P)·v + (μ·y_P)·vw,
+               θ = Y − y_Q·Z,  μ = X − x_Q·Z        (Q affine)
+
+  i.e. sparse Fq12 elements in the (1, v, vw) slots — `mul_by_014`.
+  Point updates reuse the complete RCB formulas of ops/curve.py (any
+  projective representative is a valid line anchor, so the two never
+  drift).  The loop scans the fixed |x| bit pattern with the addition
+  arm selected per step — uniform TPU lanes, vmap-able over arbitrary
+  leading batch dims exactly like ops/fq2.py.
+
+* Because line denominators are dropped, the Miller VALUE differs from
+  the host `miller_loop` by subfield factors; after final
+  exponentiation the results agree exactly (tests pin this), and every
+  consumer compares post-final-exp (`== 1`).
+
+* `multi_pairing_is_one`: per-pair Miller loops batched over the pair
+  axis, masked pairs (infinity inputs, padding) forced to one, a tree
+  product over pairs, then ONE final exponentiation for the whole
+  call — the frontier-flush shape (1 signature pair + k hash-group
+  pairs) pays the ~4500-bit exponentiation once, not per pair.
+
+Host oracle twin: crypto/bls12381.py multi_pairing_is_one — the
+fallback the breaker routes to (crypto/tpu_provider.py) and the
+cross-check tests/test_pairing.py verifies against.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto import bls12381 as oracle
+from .bls12381_groups import FQ, FQ2, G2
+from .curve import Point
+from .field import Array
+from .fq6 import Fq6Ops
+from .fq12 import Fq12Ops
+
+FQ6 = Fq6Ops(FQ2)
+FQ12 = Fq12Ops(FQ6)
+
+#: MSB-first bits of |x| after the leading 1 — the Miller loop schedule
+#: (63 steps, 5 of them with the addition arm live).
+_X_BITS = tuple(int(c) for c in bin(oracle.X_ABS)[3:])
+
+
+def _fq2_scale_fq(a: Array, s: Array) -> Array:
+    """Fq2 element × Fq scalar (component-wise Fq mul); `s` broadcasts
+    under the component axis."""
+    return FQ2.build(FQ.mul(FQ2.c0(a), s), FQ.mul(FQ2.c1(a), s))
+
+
+def _sparse_line(f: Array, c0: Array, c1: Array, c4: Array,
+                 xp: Array, yp: Array) -> Array:
+    """f · line, line = c0 + (c1·x_P)·v + (c4·y_P)·vw."""
+    return FQ12.mul_by_014(f, c0, _fq2_scale_fq(c1, xp),
+                           _fq2_scale_fq(c4, yp))
+
+
+def _dbl_line(r: Point) -> Tuple[Array, Array, Array]:
+    """Line coefficients (c0, c1, c4) of the tangent at R = (X:Y:Z):
+    (3X³ − 2Y²Z, −3X², 2YZ²) with the shared Z folded in (any projective
+    representative works — the overall Fq2 scale dies in the final
+    exponentiation)."""
+    f = FQ2
+    x, y, z = r
+    xx = f.sq(x)
+    yy = f.sq(y)
+    c0 = f.sub(f.mul_small(f.mul(xx, x), 3),
+               f.mul_small(f.mul(yy, z), 2))
+    c1 = f.neg(f.mul_small(f.mul(xx, z), 3))
+    c4 = f.mul_small(f.mul(y, f.sq(z)), 2)
+    return c0, c1, c4
+
+
+def _add_line(r: Point, qx: Array, qy: Array) -> Tuple[Array, Array, Array]:
+    """Line coefficients (c0, c1, c4) through projective R and affine
+    Q = (x_Q, y_Q): θ = Y − y_Q·Z, μ = X − x_Q·Z →
+    (θ·x_Q − μ·y_Q, −θ, μ)."""
+    f = FQ2
+    theta = f.sub(r.y, f.mul(qy, r.z))
+    mu = f.sub(r.x, f.mul(qx, r.z))
+    c0 = f.sub(f.mul(theta, qx), f.mul(mu, qy))
+    return c0, f.neg(theta), mu
+
+
+def miller_loop(px: Array, py: Array, qx: Array, qy: Array) -> Array:
+    """f_{|x|,Q}(P) up to subfield factors, conjugated for the negative
+    BLS parameter — batched over every leading dim.  px/py: (..., n) G1
+    affine limbs; qx/qy: (..., 2, n) G2' affine limbs.  Returns an Fq12
+    element (..., 2, 3, 2, n).  Infinity handling is the CALLER's (mask
+    the output to one): the arithmetic is total, so garbage coordinates
+    cost nothing but produce garbage values."""
+    q = G2.from_affine(qx, qy)
+    bits = jnp.asarray(_X_BITS, jnp.int32)
+    batch = px.shape[:-1]
+    f0 = jnp.broadcast_to(FQ12.one(),
+                          batch + FQ12.one().shape).astype(jnp.int32)
+
+    def step(carry, bit):
+        f, rx, ry, rz = carry
+        r = Point(rx, ry, rz)
+        c0, c1, c4 = _dbl_line(r)
+        f = _sparse_line(FQ12.sq(f), c0, c1, c4, px, py)
+        r = G2.dbl(r)
+        # Addition arm — always computed, selected by the (static per
+        # step, traced as data) bit so the scan body stays uniform.
+        a0, a1, a4 = _add_line(r, qx, qy)
+        f_add = _sparse_line(f, a0, a1, a4, px, py)
+        r_add = G2.add(r, q)
+        take = jnp.broadcast_to(bit.astype(bool), batch)
+        f = FQ12.where(take, f_add, f)
+        r = G2.select(take, r_add, r)
+        return (f, r.x, r.y, r.z), None
+
+    (f, _, _, _), _ = lax.scan(step, (f0, q.x, q.y, q.z), bits)
+    # x < 0: conjugate (post-final-exp this equals inversion).
+    return FQ12.conj(f)
+
+
+def multi_pairing_product(px: Array, py: Array, skip: Array,
+                          qx: Array, qy: Array) -> Array:
+    """Π_i f_{|x|,Q_i}(P_i) over the LEADING pair axis, skipped lanes
+    (infinity / padding) contributing one.  One Miller-loop trace covers
+    every pair (vmapped by batching), then a log₂ tree of Fq12 muls."""
+    f = miller_loop(px, py, qx, qy)
+    f = FQ12.where(skip, FQ12.one_like(f), f)
+    pairs = f.shape[0]
+    size = 1
+    while size < pairs:
+        size *= 2
+    if size != pairs:
+        pad = jnp.broadcast_to(FQ12.one(),
+                               (size - pairs,) + f.shape[1:]).astype(
+                                   jnp.int32)
+        f = jnp.concatenate([f, pad], axis=0)
+    while size > 1:
+        half = size // 2
+        f = FQ12.mul(f[:half], f[half:])
+        size = half
+    return f[0]
+
+
+def multi_pairing_is_one(px: Array, py: Array, p_inf: Array,
+                         qx: Array, qy: Array, q_inf: Array,
+                         mask: Array) -> Array:
+    """The device twin of crypto/bls12381.py multi_pairing_is_one:
+    Π e(P_i, Q_i) == 1 over the leading pair axis, ONE shared final
+    exponentiation.  p_inf/q_inf mark infinity inputs (skipped, like the
+    host's None pairs); mask=False marks padding lanes.  Returns a
+    scalar bool (or a batch of them for extra leading dims)."""
+    skip = p_inf | q_inf | ~mask
+    f = multi_pairing_product(px, py, skip, qx, qy)
+    return FQ12.is_one(FQ12.final_exponentiation(f))
+
+
+# -- staged jit entry points -------------------------------------------------
+#
+# The production dispatch is TWO kernels, not one: the Miller-product
+# kernel specializes on the pair-rung shape (cheap compile, ~1 min on a
+# cold CPU lane), while the final-exponentiation/verdict kernel's input
+# is a single Fq12 element whose shape is INDEPENDENT of the pair count
+# — it compiles once ever (it is by far the heaviest compile in the
+# stack: five |x|-bit square-and-multiply scan bodies plus the easy
+# part's inversion) and is shared by every rung, every caller, and the
+# persistent compile cache.  Both dispatches enqueue back-to-back;
+# nothing crosses the link between them.
+
+def _miller_product_fn(px, py, p_inf, qx, qy, q_inf, mask):
+    skip = p_inf | q_inf | ~mask
+    return multi_pairing_product(px, py, skip, qx, qy)
+
+
+miller_product_jit = jax.jit(_miller_product_fn)
+
+
+def _final_is_one_fn(f):
+    return FQ12.is_one(FQ12.final_exponentiation(f))
+
+
+final_is_one_jit = jax.jit(_final_is_one_fn)
+
+
+def multi_pairing_is_one_staged(px, py, p_inf, qx, qy, q_inf, mask):
+    """multi_pairing_is_one as the two staged dispatches above — the
+    form crypto/tpu_provider.py's kernel set uses."""
+    return final_is_one_jit(
+        miller_product_jit(px, py, p_inf, qx, qy, q_inf, mask))
+
+
+def pairing(px: Array, py: Array, qx: Array, qy: Array) -> Array:
+    """e(P, Q)³ — single-pair form, the device analog of the host
+    `pairing` (the shared cube; see crypto/bls12381.py)."""
+    return FQ12.final_exponentiation(miller_loop(px, py, qx, qy))
+
+
+# -- host-format helpers (test/bench boundary, not hot-path) ----------------
+
+def g1_affine_from_oracle(pts):
+    """[(x, y) | None, ...] → (len, n) px, py, (len,) inf numpy arrays."""
+    import numpy as np
+    n = len(pts)
+    px = np.zeros((n, FQ.n), np.int32)
+    py = np.zeros((n, FQ.n), np.int32)
+    inf = np.zeros(n, bool)
+    for i, p in enumerate(pts):
+        if p is None:
+            inf[i] = True
+            continue
+        px[i] = FQ.from_int(p[0])
+        py[i] = FQ.from_int(p[1])
+    return px, py, inf
+
+
+def g2_affine_from_oracle(pts):
+    """[((x0,x1), (y0,y1)) | None, ...] → (len,2,n) qx, qy, (len,) inf."""
+    import numpy as np
+    n = len(pts)
+    qx = np.zeros((n, 2, FQ.n), np.int32)
+    qy = np.zeros((n, 2, FQ.n), np.int32)
+    inf = np.zeros(n, bool)
+    for i, p in enumerate(pts):
+        if p is None:
+            inf[i] = True
+            continue
+        qx[i] = np.asarray(FQ2.from_ints([p[0]])[0])
+        qy[i] = np.asarray(FQ2.from_ints([p[1]])[0])
+    return qx, qy, inf

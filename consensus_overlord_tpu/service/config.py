@@ -68,6 +68,16 @@ class ConsensusConfig:
     tenant_weight: int = 1
     tenant_queue_bound: int = 0
     tenant_priority_lanes: bool = True
+    #: Device-resident pairing (crypto/tpu_provider.py): "auto" runs the
+    #: Miller loop + shared final exponentiation on device for
+    #: accelerator backends and keeps the host oracle on the CPU lane;
+    #: "on"/"off" force it.  The host oracle stays the breaker-guarded
+    #: fallback either way.
+    device_pairing: str = "auto"
+    #: Serve the verify relation's G2 MSM from per-pubkey precomputed
+    #: window tables rebuilt on reconfigure (ops/curve.py
+    #: msm_table_build; ~240 KB HBM per cached pubkey row).
+    g2_table_msm: bool = False
     #: Engine flight recorder (obs/flightrec.py): ring capacity in
     #: events; 0 disables recording entirely.
     flight_recorder_capacity: int = 512
@@ -151,6 +161,17 @@ class ConsensusConfig:
                 f">= frontier_max_batch ({self.frontier_max_batch}) — a "
                 "bound below one batch sheds traffic a single flush "
                 "could have carried")
+        if self.device_pairing not in ("auto", "on", "off"):
+            raise ValueError(
+                f"device_pairing must be auto|on|off, got "
+                f"{self.device_pairing!r} (a typo here would silently "
+                "keep the pairing on the host)")
+
+    @property
+    def device_pairing_flag(self) -> Optional[bool]:
+        """The TpuBlsCrypto ctor form: None = auto (backend-dependent),
+        True/False = forced."""
+        return {"auto": None, "on": True, "off": False}[self.device_pairing]
 
     @property
     def effective_tenant_queue_bound(self) -> int:

@@ -52,10 +52,16 @@ logger = logging.getLogger("consensus_overlord_tpu.consensus")
 PING_HEIGHT = 2**64 - 1
 
 
-def _make_crypto(backend: str, private_key: int):
+def _make_crypto(backend: str, private_key: int,
+                 config: Optional[ConsensusConfig] = None):
     if backend == "tpu":
         from ..crypto.tpu_provider import TpuBlsCrypto
-        return TpuBlsCrypto(private_key)
+        if config is None:
+            return TpuBlsCrypto(private_key)
+        return TpuBlsCrypto(
+            private_key,
+            device_pairing=config.device_pairing_flag,
+            g2_table_msm=config.g2_table_msm)
     if backend == "cpu":
         from ..crypto.provider import CpuBlsCrypto
         return CpuBlsCrypto(private_key)
@@ -80,7 +86,8 @@ class Consensus:
             config.controller_port, compat=config.proto_compat)
         self.network = network or NetworkClient(
             config.network_port, compat=config.proto_compat)
-        self.crypto = crypto or _make_crypto(config.crypto_backend, private_key)
+        self.crypto = crypto or _make_crypto(config.crypto_backend,
+                                             private_key, config)
         # One metric surface threads through every hot-path layer: the
         # WAL (append/fsync), the frontier (batch shape + queue wait),
         # the provider (device dispatch phases), and the engine (rounds,

@@ -196,6 +196,21 @@ def main():
           f"staircase/current {t_st / t_cur:.2f}x, "
           f"f32(b=7 floor)/current {t_f / t_cur:.2f}x")
 
+    # Self-contained ledger tail: the production formulation's useful
+    # conv MAC rate — this rung's own metric, never mixed into the BLS
+    # headline trend.
+    import json
+
+    from consensus_overlord_tpu.obs import ledger
+    print(json.dumps(ledger.build_record(
+        "ladder_field_mul_gmacs", round(fmac / t_cur / 1e9, 3), "gmac/s",
+        context={"backend": jax.default_backend(), "batch": B, "chain": K,
+                 "current_us_per_step": round(t_cur * 1e6, 2),
+                 "dot_general_vs_current": round(t_dg / t_cur, 3),
+                 "staircase_vs_current": round(t_st / t_cur, 3),
+                 "f32_b7_floor_vs_current": round(t_f / t_cur, 3),
+                 "i32_f32_mac_ratio": round(ti / tf, 3)})))
+
 
 if __name__ == "__main__":
     main()

@@ -30,7 +30,6 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
@@ -47,58 +46,16 @@ WINDOWS = 16  # 64-bit RLC weights, w=4
 DIGITS = 16
 
 
+# The formulations under test are PRODUCTION code now (r12 promoted the
+# winner behind tpu_provider's g2_table_msm knob): table build + gather
+# MSM live in ops/curve.py; this script stays the reproducible A/B.
+
 def build_tables(pk: Point) -> Point:
-    """(R, ...) pubkeys → (R, WINDOWS, DIGITS, ...) multiples
-    T[r, j, d] = d · 16^j · P_r, MS-window-first (j=0 is the most
-    significant window, matching unpack_weight_bits' MSB-first bits).
-    Build cost ≈ 60 doublings + 15×16 adds per key, batched over keys —
-    paid once per reconfigure, not per round."""
-    g2 = dev.G2
-
-    def window_step(p, _):
-        nxt = p
-        for _ in range(4):
-            nxt = g2.dbl(nxt)
-        return nxt, p  # collect 16^j·P for j = 0.. (LS first)
-
-    _, per_win = lax.scan(window_step, pk, None, length=WINDOWS)
-    # per_win: (WINDOWS, R, ...) with j=0 least significant; flip so
-    # j=0 is the MOST significant window.
-    per_win = Point(per_win.x[::-1], per_win.y[::-1], per_win.z[::-1])
-
-    def digit_step(acc, _):
-        nxt = g2.add(acc, per_win)
-        return nxt, acc  # collect d·16^j·P for d = 0..
-
-    inf = g2.infinity_like(per_win.x)
-    _, tab = lax.scan(digit_step, inf, None, length=DIGITS)
-    # tab: (DIGITS, WINDOWS, R, ...) → (R, WINDOWS, DIGITS, ...)
-    perm = (2, 1, 0) + tuple(range(3, tab.x.ndim))
-    return Point(tab.x.transpose(perm), tab.y.transpose(perm),
-                 tab.z.transpose(perm))
+    return dev.G2.msm_table_build(pk, windows=WINDOWS, digits=DIGITS)
 
 
 def msm_tables(tab: Point, rows, bits) -> Point:
-    """Σ_i k_i·P_{rows_i} from precomputed tables: per lane, gather one
-    point per window by (row, window, digit) and tree-sum 16 points.
-    No doublings anywhere."""
-    g2 = dev.G2
-    digits = (bits.reshape(bits.shape[0], WINDOWS, 4)
-              * jnp.asarray([8, 4, 2, 1], jnp.int32)).sum(-1)  # (B, 16)
-    r = rows[:, None].astype(jnp.int32)
-    j = jnp.arange(WINDOWS, dtype=jnp.int32)[None, :]
-    pts = Point(tab.x[r, j, digits], tab.y[r, j, digits],
-                tab.z[r, j, digits])  # (B, 16, ...)
-    # Tree-sum over the window axis (4 levels), then over lanes.
-    p = pts
-    width = WINDOWS
-    while width > 1:
-        half = width // 2
-        p = g2.add(Point(p.x[:, :half], p.y[:, :half], p.z[:, :half]),
-                   Point(p.x[:, half:], p.y[:, half:], p.z[:, half:]))
-        width = half
-    per_lane = Point(p.x[:, 0], p.y[:, 0], p.z[:, 0])
-    return g2.tree_sum(per_lane)
+    return dev.G2.msm_from_tables(tab, rows, bits)
 
 
 def main():
@@ -164,6 +121,21 @@ def main():
 
     print(f"-- summary: tables/ladder {t_tab / t_lad:.2f}x "
           f"({'WIN' if t_tab < t_lad else 'LOSS'}) --")
+
+    # Self-contained ledger tail: this rung's own metric, never mixed
+    # into the BLS headline trend.  Headline > 1 = tables beat the
+    # ladder (the condition for flipping g2_table_msm on by default).
+    import json
+
+    from consensus_overlord_tpu.obs import ledger
+    print(json.dumps(ledger.build_record(
+        "ladder_g2_table_msm_speedup", round(t_lad / t_tab, 4), "x",
+        context={"backend": jax.default_backend(), "batch": B,
+                 "iters": ITERS,
+                 "ladder_ms_per_msm": round(t_lad * 1e3, 2),
+                 "tables_ms_per_msm": round(t_tab * 1e3, 2),
+                 "table_build_s": round(t_build, 2),
+                 "table_gb_on_device": round(gb, 3)})))
 
 
 if __name__ == "__main__":

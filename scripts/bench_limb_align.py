@@ -190,6 +190,20 @@ def main():
           f"conv share ~{t_cv / t_cur:.2f}  reduce share ~{t_rd / t_cur:.2f}",
           flush=True)
 
+    # Self-contained ledger tail: this rung's own metric, never mixed
+    # into the BLS headline trend.  Headline > 1 means the transposed
+    # (limb-on-sublanes) layout beats production.
+    import json
+
+    from consensus_overlord_tpu.obs import ledger
+    print(json.dumps(ledger.build_record(
+        "ladder_limb_align_transposed_speedup", round(t_cur / t_T, 4), "x",
+        context={"backend": jax.default_backend(), "batch": B, "chain": K,
+                 "current_us_per_step": round(t_cur * 1e6, 2),
+                 "transposed_us_per_step": round(t_T * 1e6, 2),
+                 "conv_share": round(t_cv / t_cur, 3),
+                 "reduce_share": round(t_rd / t_cur, 3)})))
+
 
 if __name__ == "__main__":
     main()

@@ -128,6 +128,7 @@ def main():
     def strict_x(curve, p):
         return dev.FQ.strict(curve.to_affine(p)[0][0])
 
+    results = {}
     for name, curve, pt in (("g1", dev.G1, g1pt), ("g2", dev.G2, g2pt)):
         ladder = jax.jit(lambda b, c=curve, p=pt: strict_x(
             c, c.msm_bits(p, b)))
@@ -135,7 +136,24 @@ def main():
             c, digit_plane_msm(c, p, b)))
         t_l = time_honest(f"{name}_ladder", ladder, fresh_bits)
         t_p = time_honest(f"{name}_digitplane", planes, fresh_bits)
+        results[name] = (t_l, t_p)
         print(f"{name}: digit-plane / ladder = {t_p / t_l:.2f}x", flush=True)
+
+    # Self-contained ledger tail: this rung's own metric, never mixed
+    # into the BLS headline trend.  Headline > 1 would mean the
+    # digit-plane formulation finally beats the production ladder
+    # (historically ~0.5x — the kept negative result).
+    import json
+
+    from consensus_overlord_tpu.obs import ledger
+    g2_l, g2_p = results["g2"]
+    print(json.dumps(ledger.build_record(
+        "ladder_msm_digitplane_speedup_g2", round(g2_l / g2_p, 4), "x",
+        context={"backend": jax.default_backend(), "batch": N,
+                 "g1_ladder_ms": round(results["g1"][0], 2),
+                 "g1_digitplane_ms": round(results["g1"][1], 2),
+                 "g2_ladder_ms": round(g2_l, 2),
+                 "g2_digitplane_ms": round(g2_p, 2)})))
 
 
 if __name__ == "__main__":

@@ -69,6 +69,19 @@ def main():
     assert got == want, "pallas chain diverged from XLA chain"
     print("correctness: chained results identical")
 
+    # Self-contained ledger tail (obs/ledger.py): this rung's own
+    # metric, never mixed into the BLS headline trend.
+    import json
+
+    from consensus_overlord_tpu.obs import ledger
+    print(json.dumps(ledger.build_record(
+        "ladder_pallas_field_mul_ratio_vs_xla",
+        round(t_x / t_p, 4), "x",
+        context={"backend": jax.default_backend(), "batch": B,
+                 "reps": REPS,
+                 "xla_ns_per_mul_lane": round(t_x / REPS / B * 1e9, 2),
+                 "pallas_ns_per_mul_lane": round(t_p / REPS / B * 1e9, 2)})))
+
 
 if __name__ == "__main__":
     main()

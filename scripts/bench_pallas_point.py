@@ -61,6 +61,7 @@ def main():
             px, py, pz = fused(px, py, pz, qx, qy, qz)
         return px.sum()
 
+    bests = {}
     for name, fn in (("xla", xla_chain), ("pallas", pallas_chain)):
         j = jax.jit(fn)
         jax.device_get(j(fresh()))  # warm
@@ -74,8 +75,24 @@ def main():
             print(f"{name:7s} {dt:8.2f} ms  digest={int(out) & 0xffffffff}",
                   flush=True)
             best = dt if best is None or dt < best else best
+        bests[name] = best
         print(f"{name}: best {best:.2f} ms "
               f"({K * B / best * 1000:.0f} adds/s)", flush=True)
+
+    # Self-contained ledger tail: this rung's own metric, never mixed
+    # into the BLS headline trend.
+    import json
+
+    from consensus_overlord_tpu.obs import ledger
+    print(json.dumps(ledger.build_record(
+        "ladder_pallas_point_add_ratio_vs_xla",
+        round(bests["xla"] / bests["pallas"], 4), "x",
+        context={"backend": jax.default_backend(), "batch": B, "chain": K,
+                 "xla_ms": round(bests["xla"], 3),
+                 "pallas_ms": round(bests["pallas"], 3),
+                 "xla_adds_per_s": round(K * B / bests["xla"] * 1000, 1),
+                 "pallas_adds_per_s":
+                     round(K * B / bests["pallas"] * 1000, 1)})))
 
 
 if __name__ == "__main__":

@@ -68,10 +68,16 @@ def cmd_trend(args) -> int:
                           plateau_runs=args.plateau_runs,
                           plateau_band=args.plateau_band / 100.0)
     unit = next((r.unit for r in records if r.unit), "")
+    # A glob can sweep a whole family of distinct metrics (the bench
+    # ladder): name each rung's metric on its row and drop the single
+    # trailing unit line, which would only describe one of them.
+    mixed = len({(r.metric, r.unit) for r in records}) > 1
     print(f"{'run':<10} {'value':>14} {'delta%':>9} {'vs_base':>8} "
           f"{'occ':>6}  note")
     for row in report["rows"]:
         note = []
+        if mixed:
+            note.append(f"{row['metric']} [{row['unit'] or '-'}]")
         if row.get("plateau"):
             note.append("<- plateau")
         for k, v in (row.get("env_drift") or {}).items():
@@ -81,7 +87,7 @@ def cmd_trend(args) -> int:
               f"{('%+.2f' % delta) if delta is not None else '-':>9} "
               f"{_fmt(row['vs_baseline']):>8} "
               f"{_fmt(row['occupancy']):>6}  {' | '.join(note)}")
-    if unit:
+    if unit and not mixed:
         print(f"(value unit: {unit})")
     for p in report["plateaus"]:
         print(f"PLATEAU: {p['from']} -> {p['to']} flat across {p['runs']} "

@@ -1,0 +1,68 @@
+"""CI pairing smoke: device multi-pairing verdict identity vs the host
+oracle at N=4 (sub-minute on the CPU lane with a warm compile cache).
+
+Checks, per randomized (sig, pk, msg) set (half of them invalid):
+  * the device staged verdict kernels (ops/pairing.py — batched Miller
+    loop + ONE shared final exponentiation) agree with
+    crypto/bls12381.py multi_pairing_is_one bit-for-bit;
+  * the verdicts match the a-priori expectation (valid sets True,
+    tampered sets False).
+
+Exit 0 on full agreement, 1 with a per-set report otherwise.
+
+Usage: python scripts/pairing_smoke.py [N]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from consensus_overlord_tpu.compile_cache import enable
+
+enable()
+
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_overlord_tpu.core.sm3 import sm3_hash
+from consensus_overlord_tpu.crypto import bls12381 as oracle
+from consensus_overlord_tpu.ops import pairing as pr
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+
+
+def main() -> int:
+    neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+    failures = 0
+    for i in range(N):
+        sk = 0xC0FFEE + 31 * i
+        h = sm3_hash(b"pairing-smoke-%d" % i)
+        sig = oracle.g1_decompress(oracle.sign(sk, h))
+        pk = oracle.g2_decompress(oracle.sk_to_pk(sk))
+        if i % 2 == 1:
+            sig = oracle.g1_mul(sig, 7)  # valid point, forged signature
+        h_pt = oracle.hash_to_g1(h, b"")
+        want = i % 2 == 0
+
+        px, py, pinf = pr.g1_affine_from_oracle([sig, h_pt])
+        qx, qy, qinf = pr.g2_affine_from_oracle([neg_g2, pk])
+        got = bool(pr.multi_pairing_is_one_staged(
+            jnp.asarray(px), jnp.asarray(py), jnp.asarray(pinf),
+            jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(qinf),
+            jnp.asarray(np.ones(2, bool))))
+        host = oracle.multi_pairing_is_one([(sig, neg_g2), (h_pt, pk)])
+        ok = got == host == want
+        print(f"set {i}: device={got} host={host} expected={want}"
+              f" {'OK' if ok else 'MISMATCH'}", flush=True)
+        failures += 0 if ok else 1
+    if failures:
+        print(f"FAIL: {failures}/{N} sets disagree")
+        return 1
+    print(f"ok: {N}/{N} device verdicts identical to the host oracle")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

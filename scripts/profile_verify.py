@@ -168,6 +168,8 @@ def main() -> int:
         "full_ms": round(full_s * 1e3, 3),
         "verifies_per_s": round(n / full_s, 1),
         "stages_ms": stages_ms,
+        "device_pairing": provider._pairing_on_device,
+        "pairing_host_fallbacks": provider.pairing_host_fallbacks,
         "occupancy": summary["occupancy"],
         "devices": summary["devices"],
         "sharded": sharded,
