@@ -42,6 +42,21 @@ logging.getLogger("jax._src.xla_bridge").setLevel(logging.ERROR)
 # verifies/s).  Override with BENCH_N for other points.
 N = int(os.environ.get("BENCH_N", "8192"))       # votes per round-batch
 ITERS = int(os.environ.get("BENCH_ITERS", "2"))  # timed iterations
+#: --mesh D: bench the provider's MESH kernel set (parallel/sharded.py,
+#: including the sharded pairing verdict) over a D-lane virtual CPU
+#: mesh and emit a DISTINCT mesh_* ledger metric, so the mesh rung
+#: trends separately from the single-chip headline.  Parsed here, at
+#: module level, because --xla_force_host_platform_device_count only
+#: takes effect if it's in XLA_FLAGS before jax initializes — which is
+#: why every jax import in this file sits inside a function.
+MESH = int(sys.argv[sys.argv.index("--mesh") + 1]) \
+    if "--mesh" in sys.argv else 0
+if MESH:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{_flags} --xla_force_host_platform_device_count={MESH}"
+        ).strip()
 #: Distinct message hashes per batch.  1 = the single-hash best case
 #: (all votes on one block); 3 = the realistic mixed frontier batch
 #: (votes + proposal + choke traffic) through the fused k-group kernel
@@ -100,7 +115,15 @@ def main():
     sigs, hashes, pks = _fixture()
     h = hashes[0]
 
-    provider = TpuBlsCrypto(0xA11CE)
+    mesh = None
+    if MESH:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from consensus_overlord_tpu.parallel import make_mesh
+
+        mesh = make_mesh(MESH)
+    provider = TpuBlsCrypto(0xA11CE, mesh=mesh)
     provider.update_pubkeys(pks)          # per-reconfigure cost, not per-round
 
     # Warmup: compile + one correctness pass.
@@ -173,13 +196,19 @@ def main():
     # used to be a separate stderr line now live inside it, so the
     # recorded BENCH tail is machine-clean JSON end to end.
     from consensus_overlord_tpu.obs import ledger
+    # The mesh rung is its own ledger family: an 8-lane virtual CPU
+    # mesh divides one host's cores across shard_map programs, so its
+    # absolute rate is not comparable to the single-chip headline —
+    # a shared name would make every mesh run read as a regression.
+    metric = ("mesh_bls12381_sig_verifies_per_sec" if MESH
+              else "bls12381_sig_verifies_per_sec_per_chip")
     print(json.dumps(ledger.build_record(
-        "bls12381_sig_verifies_per_sec_per_chip",
+        metric,
         round(rate, 2), "verifies/s",
         profiler=prof,
         context={
             "batch": N, "iters": ITERS, "distinct_hashes": HASHES,
-            "depth": depth,
+            "depth": depth, "mesh_devices": MESH,
             "sync_verifies_per_s": round(sync_rate, 2),
             "pipelined_verifies_per_s": round(rate, 2),
             cpu_key: round(cpu_best, 2),

@@ -39,6 +39,11 @@ DEVICE_FILE_GLOBS = (
     "consensus_overlord_tpu/crypto/ed25519_tpu.py",
     "consensus_overlord_tpu/crypto/ecdsa_tpu.py",
     "consensus_overlord_tpu/crypto/tenancy.py",
+    # The mesh kernel factories and multi-host plumbing are device
+    # paths too (r14: mesh pairing made them production-path): a
+    # swallowed collective/runtime-init failure there degrades just as
+    # silently as one in the provider.
+    "consensus_overlord_tpu/parallel/*.py",
 )
 
 #: Presence of any of these in a try body marks it a device path.

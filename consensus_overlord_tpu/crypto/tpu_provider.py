@@ -290,32 +290,61 @@ class _SingleChipKernels:
         lambda *a: _verify_round_multi_tab(*a))
     build_g2_tables = staticmethod(lambda *a: _build_g2_tables(*a))
     multi_pairing = staticmethod(lambda *a: _multi_pairing(*a))
+    #: Operand feed: single-chip inputs are plain device puts (the jit
+    #: handles placement); the mesh set overrides with per-host shard
+    #: feeding.  The axis_index arg mirrors _MeshKernels.ship.
+    ship = staticmethod(lambda arr, axis_index=0: jnp.asarray(arr))
+    ship_replicated = staticmethod(lambda arr: jnp.asarray(arr))
     lanes = 1
 
 
 class _MeshKernels:
     """The same kernel surface jitted over a device mesh via shard_map
     (parallel/sharded.py): signature lanes and pubkey-row indices shard
-    across devices, the pubkey cache is replicated, and partial group
-    sums combine over the mesh axis (ICI).  Batch padding must be a
-    multiple of the mesh size; the provider's pad ladder is adjusted
-    through `lanes`."""
+    across devices, the pubkey cache is replicated, partial group sums
+    combine over the mesh axis (ICI), and the pairing verdict runs as
+    the sharded staged pair (per-device Miller partials, one all-gather
+    of D Fq12 elements, one shared final exponentiation).  Batch
+    padding must be a multiple of the mesh size; the provider's pad
+    ladders (batch AND pair) are adjusted through `lanes`."""
 
     def __init__(self, mesh):
         from ..parallel import (
+            host_shard_array,
             sharded_g1_validate_sum,
             sharded_g2_sum_rows,
             sharded_g2_validate,
+            sharded_multi_pairing_is_one,
             sharded_verify_round,
             sharded_verify_round_multi,
         )
         self.mesh = mesh
         self.lanes = mesh.devices.size
+        self._host_shard_array = host_shard_array
         self.g2_validate = sharded_g2_validate(mesh)
         self.g1_validate_sum = sharded_g1_validate_sum(mesh)
         self.g2_sum_rows = sharded_g2_sum_rows(mesh)
         self.verify_round = sharded_verify_round(mesh)
         self.verify_round_multi = sharded_verify_round_multi(mesh)
+        self.multi_pairing = sharded_multi_pairing_is_one(mesh)
+
+    def ship(self, arr, axis_index: int = 0):
+        """Lanes-sharded operand feed: on a multi-process (DCN) mesh
+        each host contributes its local lanes through
+        jax.make_array_from_process_local_data, so a frontier flush is
+        one mesh dispatch; single-process meshes are a plain device
+        put.  axis_index picks which array axis carries the lanes
+        (1 for the multi-hash gmask's (k, B) layout)."""
+        if axis_index == 0:
+            return self._host_shard_array(self.mesh, arr)
+        from jax.sharding import PartitionSpec
+        spec = PartitionSpec(*([None] * axis_index),
+                             self.mesh.axis_names[0])
+        return self._host_shard_array(self.mesh, arr, spec=spec)
+
+    def ship_replicated(self, arr):
+        """Host-identical operand feed (the replicated pubkey cache)."""
+        return self._host_shard_array(self.mesh, arr, replicated=True)
 
 
 def _affine_to_oracle_g1(ax, ay, ainf) -> Optional[Tuple[int, int]]:
@@ -378,8 +407,11 @@ class TpuBlsCrypto:
         becomes the fallback/cross-check twin.  None (default) reads
         CONSENSUS_DEVICE_PAIRING (1/0/auto; auto = on for accelerator
         backends, off on the CPU lane where the host oracle is cheaper
-        than the emulated tower).  Single-chip kernels only — mesh
-        providers keep the host pairing tail.
+        than the emulated tower).  Mesh providers run the sharded
+        staged pair (parallel/sharded.py sharded_multi_pairing_is_one:
+        per-device Miller partials over the pair shard, one all-gather
+        of D Fq12 elements, the shared final exponentiation replicated)
+        — the same breaker/fallback/cross-check semantics as one chip.
 
         g2_table_msm: serve the verify relation's G2 MSM from
         per-pubkey precomputed window tables rebuilt on reconfigure
@@ -404,7 +436,9 @@ class TpuBlsCrypto:
                 device_pairing = mode not in ("0", "off", "false")
         #: Device-resident pairing verdicts (see ctor docstring).  The
         #: host oracle remains the fallback twin behind the breaker.
-        self._pairing_on_device = bool(device_pairing) and single_chip
+        #: Mesh kernel sets carry their own sharded staged pair, so the
+        #: knob alone decides — no single-chip gate (r14).
+        self._pairing_on_device = bool(device_pairing)
         #: CONSENSUS_PAIRING_CROSSCHECK=1: every device verdict is also
         #: recomputed on the host oracle and mismatches are logged —
         #: the soak/debug twin mode (costs the full aggregate readback
@@ -513,14 +547,18 @@ class TpuBlsCrypto:
         """Dispatch the device multi-pairing verdict kernel over a
         flush's pairs.  g1s: [(x, y, inf)] G1 strict-limb coords ((n,)
         each, device or host); g2s: the matching [(x, y, inf)] Fq2
-        coords ((2, n)).  Pads to the _PAIR_SIZES ladder (masked lanes
-        contribute one) and returns the verdict device array — or None
-        after feeding the breaker if the dispatch failed, so callers
-        fall back to the host oracle twin."""
+        coords ((2, n)).  Pads to the _PAIR_SIZES ladder, rounded up to
+        a multiple of the kernel set's lane count — mesh pairing shards
+        the pair axis across devices, and masked lanes contribute one —
+        and returns the verdict device array — or None after feeding
+        the breaker if the dispatch failed, so callers fall back to the
+        host oracle twin."""
         try:
             self.breaker.raise_if_injected("pairing")
             k = len(g1s)
             size = next((s for s in _PAIR_SIZES if k <= s), k)
+            lanes = self._kernels.lanes
+            size = -(-size // lanes) * lanes
             z1 = jnp.zeros((dev.FQ.n,), jnp.int32)
             z2 = jnp.zeros((2, dev.FQ.n), jnp.int32)
             pad = size - k
@@ -535,7 +573,7 @@ class TpuBlsCrypto:
             mask = np.arange(size) < k
             with annotate("tpu_bls.pairing.dispatch"):
                 return self._kernels.multi_pairing(
-                    px, py, pinf, qx, qy, qinf, jnp.asarray(mask))
+                    px, py, pinf, qx, qy, qinf, self._kernels.ship(mask))
         except Exception as e:  # noqa: BLE001 — device pairing dispatch failed
             self._pairing_failed(e)
             return None
@@ -672,9 +710,9 @@ class TpuBlsCrypto:
             call.observe("parse", time.perf_counter() - t0)
             t0 = time.perf_counter()
             with annotate("tpu_bls.aggregate.dispatch"):
+                ship = self._kernels.ship
                 out = self._kernels.g1_validate_sum(
-                    jnp.asarray(x), jnp.asarray(sign_f), jnp.asarray(inf),
-                    jnp.asarray(ok))
+                    ship(x), ship(sign_f), ship(inf), ship(ok))
             call.observe("dispatch", time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 — device dispatch failed
             self._device_failed("aggregate", e)
@@ -739,7 +777,8 @@ class TpuBlsCrypto:
             pkx, pky, pkz = self._pk_device()
             with annotate("tpu_bls.verify_aggregated.dispatch"):
                 out = self._kernels.g2_sum_rows(
-                    jnp.asarray(rows), jnp.asarray(mask), pkx, pky, pkz)
+                    self._kernels.ship(rows), self._kernels.ship(mask),
+                    pkx, pky, pkz)
             call.observe("dispatch", time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 — device dispatch failed
             self._device_failed("verify_aggregated", e)
@@ -989,20 +1028,19 @@ class TpuBlsCrypto:
         the multi-pairing verdict kernel pipelined right behind it);
         return resolve() → List[bool]."""
         t0 = time.perf_counter()
+        ship = self._kernels.ship
         if self._use_g2_tables:
             tx, ty, tz = self._pk_tables()
             with annotate("tpu_bls.verify_round.dispatch"):
                 out = self._kernels.verify_round_tab(
-                    jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-                    jnp.asarray(sok), jnp.asarray(wpacked),
-                    jnp.asarray(rows), tx, ty, tz)
+                    ship(sx), ship(ssign), ship(sinf),
+                    ship(sok), ship(wpacked), ship(rows), tx, ty, tz)
         else:
             pkx, pky, pkz = self._pk_device()
             with annotate("tpu_bls.verify_round.dispatch"):
                 out = self._kernels.verify_round(
-                    jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-                    jnp.asarray(sok), jnp.asarray(wpacked),
-                    jnp.asarray(rows), pkx, pky, pkz)
+                    ship(sx), ship(ssign), ship(sinf),
+                    ship(sok), ship(wpacked), ship(rows), pkx, pky, pkz)
         self._observe_phase("dispatch", t0, call)
         verdict_dev = None
         if self._pairing_on_device:
@@ -1111,20 +1149,21 @@ class TpuBlsCrypto:
         for g, h in enumerate(ghashes):
             gmask[g, groups[h]] = True
         t0 = self._observe_phase("prep", t0, call)
+        ship = self._kernels.ship
         if self._use_g2_tables:
             tx, ty, tz = self._pk_tables()
             with annotate("tpu_bls.verify_round_multi.dispatch"):
                 out = self._kernels.verify_round_multi_tab(
-                    jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-                    jnp.asarray(sok), jnp.asarray(wpacked),
-                    jnp.asarray(rows), jnp.asarray(gmask), tx, ty, tz)
+                    ship(sx), ship(ssign), ship(sinf),
+                    ship(sok), ship(wpacked), ship(rows),
+                    ship(gmask, axis_index=1), tx, ty, tz)
         else:
             pkx, pky, pkz = self._pk_device()
             with annotate("tpu_bls.verify_round_multi.dispatch"):
                 out = self._kernels.verify_round_multi(
-                    jnp.asarray(sx), jnp.asarray(ssign), jnp.asarray(sinf),
-                    jnp.asarray(sok), jnp.asarray(wpacked),
-                    jnp.asarray(rows), jnp.asarray(gmask), pkx, pky, pkz)
+                    ship(sx), ship(ssign), ship(sinf),
+                    ship(sok), ship(wpacked), ship(rows),
+                    ship(gmask, axis_index=1), pkx, pky, pkz)
         self._observe_phase("dispatch", t0, call)
         lane_hashes = self._lane_hashes(groups, n)
         verdict_dev = None
@@ -1231,13 +1270,30 @@ class TpuBlsCrypto:
         sharded_allgather_seconds and per-device shard-fetch latency
         through the bound profiler; returns the timings.
 
-        COSTS real dispatches (plus a one-time compile of the twin on
+        The pairing stage gets the same split (r14): the collective-free
+        Miller twin (sharded_miller_partial_local — per-device Miller
+        loops + local tree product, output still sharded) vs the full
+        Miller-product kernel (all_gather of the D Fq12 partials + the
+        replicated combine tree); the difference is the pairing combine.
+        The shared final exponentiation is deliberately excluded — it is
+        replicated and shape-independent, and its cost already shows in
+        the verify_batch/pairing stage histogram.  Observes
+        sharded_pairing_partial_seconds / sharded_pairing_combine_seconds
+        on a generator-pair fixture (one pair per lane; only stage
+        timing matters, not the verdict).
+
+        COSTS real dispatches (plus a one-time compile of the twins on
         `warm`), so it runs where sampling is explicit —
         scripts/profile_verify.py and ProfileSession captures — never
         on the per-batch hot path.  Works on a 1-device mesh too (the
         combine stage then measures all_gather's single-device cost)."""
-        from ..parallel import make_mesh, sharded_verify_round, \
-            sharded_verify_round_local
+        from ..parallel import (
+            make_mesh,
+            sharded_miller_partial_local,
+            sharded_miller_product,
+            sharded_verify_round,
+            sharded_verify_round_local,
+        )
 
         n = len(signatures)
         mesh = getattr(self._kernels, "mesh", None)
@@ -1245,8 +1301,11 @@ class TpuBlsCrypto:
             if mesh is None:
                 mesh = make_mesh()  # every local device; 1 is fine
             self._stage_probe = (sharded_verify_round_local(mesh),
-                                 sharded_verify_round(mesh), mesh)
-        local_fn, full_fn, mesh = self._stage_probe
+                                 sharded_verify_round(mesh),
+                                 sharded_miller_partial_local(mesh),
+                                 sharded_miller_product(mesh), mesh)
+        (local_fn, full_fn, pair_local_fn, pair_full_fn,
+         mesh) = self._stage_probe
         lanes = mesh.devices.size
         # Metrics detached around prep: the probe's synthetic batch must
         # not pollute frontier_batch_occupancy / frontier_padded_lanes,
@@ -1284,12 +1343,42 @@ class TpuBlsCrypto:
             jax.block_until_ready(full_fn(*args))
         t_full = time.perf_counter() - t0
         t_combine = max(t_full - t_local, 0.0)
+        # Pairing split on a generator-pair fixture: e(G1, −G2) per lane,
+        # every lane live — representative Miller work, verdict unused.
+        pair_args = (
+            jnp.asarray(np.tile(np.asarray(dev.FQ.from_int(
+                oracle.G1_GEN[0])), (lanes, 1))),
+            jnp.asarray(np.tile(np.asarray(dev.FQ.from_int(
+                oracle.G1_GEN[1])), (lanes, 1))),
+            jnp.zeros(lanes, bool),
+            jnp.asarray(np.tile(np.asarray(_NEG_G2_GEN_X), (lanes, 1, 1))),
+            jnp.asarray(np.tile(np.asarray(_NEG_G2_GEN_Y), (lanes, 1, 1))),
+            jnp.zeros(lanes, bool),
+            jnp.ones(lanes, bool),
+        )
+        if warm:
+            jax.block_until_ready(pair_local_fn(*pair_args))
+            jax.block_until_ready(pair_full_fn(*pair_args))
+        t0 = time.perf_counter()
+        with annotate("tpu_bls.probe.pairing_partial"):
+            jax.block_until_ready(pair_local_fn(*pair_args))
+        t_pair_local = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with annotate("tpu_bls.probe.pairing_full"):
+            jax.block_until_ready(pair_full_fn(*pair_args))
+        t_pair_full = time.perf_counter() - t0
+        t_pair_combine = max(t_pair_full - t_pair_local, 0.0)
         if self.prof is not None:
             self.prof.sharded("partial_reduce", t_local)
             self.prof.sharded("allgather", t_combine)
+            self.prof.sharded("pairing_partial", t_pair_local)
+            self.prof.sharded("pairing_combine", t_pair_combine)
             self._shard_latencies(local_out[2], sampled=True)
         return {"devices": int(lanes), "batch": n, "padded": int(size),
                 "partial_reduce_s": t_local, "allgather_s": t_combine,
+                "pairing_partial_s": t_pair_local,
+                "pairing_combine_s": t_pair_combine,
+                "pairing_full_s": t_pair_full,
                 "full_s": t_full}
 
     @staticmethod
@@ -1371,9 +1460,9 @@ class TpuBlsCrypto:
             inf[:n] = parsed.infinity
             ok = np.zeros(size, bool)
             ok[:n] = parsed.wellformed
+            ship = self._kernels.ship
             px, py, pz, valid = jax.device_get(self._kernels.g2_validate(
-                jnp.asarray(x), jnp.asarray(sgn), jnp.asarray(inf),
-                jnp.asarray(ok)))
+                ship(x), ship(sgn), ship(inf), ship(ok)))
             aff = dev.g2_to_oracle(Point(jnp.asarray(px[:n]),
                                          jnp.asarray(py[:n]),
                                          jnp.asarray(pz[:n])))
@@ -1447,8 +1536,8 @@ class TpuBlsCrypto:
             px[:self._pk_px.shape[0]] = self._pk_px
             py[:self._pk_py.shape[0]] = self._pk_py
             pz[:self._pk_pz.shape[0]] = self._pk_pz
-            self._pk_dev = (jnp.asarray(px), jnp.asarray(py),
-                            jnp.asarray(pz))
+            ship_r = self._kernels.ship_replicated
+            self._pk_dev = (ship_r(px), ship_r(py), ship_r(pz))
         return self._pk_dev
 
     def _pk_tables(self) -> Tuple[jax.Array, jax.Array, jax.Array]:

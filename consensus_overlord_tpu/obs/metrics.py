@@ -214,6 +214,18 @@ class Metrics:
             "(all-gather of partials over ICI + replicated finish) — "
             "sampled probe",
             buckets=STAGE_SECONDS_BUCKETS, registry=self.registry)
+        self.sharded_pairing_partial_seconds = Histogram(
+            "sharded_pairing_partial_seconds",
+            "Per-device local stage of the mesh pairing (sharded Miller "
+            "loops + local Fq12 tree product, no collective) — sampled "
+            "probe",
+            buckets=STAGE_SECONDS_BUCKETS, registry=self.registry)
+        self.sharded_pairing_combine_seconds = Histogram(
+            "sharded_pairing_combine_seconds",
+            "Cross-device combine stage of the mesh pairing (all-gather "
+            "of the D Fq12 partials over ICI + replicated combine tree; "
+            "final exponentiation excluded) — sampled probe",
+            buckets=STAGE_SECONDS_BUCKETS, registry=self.registry)
         self.mesh_devices = Gauge(
             "mesh_devices",
             "Devices in the crypto provider's dispatch mesh (1 = "

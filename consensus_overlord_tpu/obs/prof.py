@@ -281,14 +281,21 @@ class DeviceProfiler:
 
     def sharded(self, phase: str, seconds: float) -> None:
         """One mesh-probe observation: phase is 'partial_reduce' (the
-        per-device local validate+MSM work) or 'allgather' (the ICI
-        combine: all-gather of D partials + replicated log2(D) finish)."""
+        per-device local validate+MSM work), 'allgather' (the ICI
+        combine: all-gather of D partials + replicated log2(D) finish),
+        'pairing_partial' (per-device Miller loops + local Fq12 tree),
+        or 'pairing_combine' (all-gather of the D Fq12 partials +
+        replicated combine tree)."""
         if self.metrics is None:
             return
         if phase == "partial_reduce":
             self.metrics.sharded_partial_reduce_seconds.observe(seconds)
         elif phase == "allgather":
             self.metrics.sharded_allgather_seconds.observe(seconds)
+        elif phase == "pairing_partial":
+            self.metrics.sharded_pairing_partial_seconds.observe(seconds)
+        elif phase == "pairing_combine":
+            self.metrics.sharded_pairing_combine_seconds.observe(seconds)
 
     # -- read side ---------------------------------------------------------
 

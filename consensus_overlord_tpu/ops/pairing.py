@@ -144,6 +144,27 @@ def miller_loop(px: Array, py: Array, qx: Array, qy: Array) -> Array:
     return FQ12.conj(f)
 
 
+def fq12_tree_product(f: Array) -> Array:
+    """Π over the LEADING axis of an Fq12 stack: one-padded up to a
+    power of two, then a log₂ tree of Fq12 muls.  Shared by the pair
+    product below and the mesh combine (parallel/sharded.py, where the
+    leading axis is the D all-gathered per-device partials)."""
+    size = f.shape[0]
+    target = 1
+    while target < size:
+        target *= 2
+    if target != size:
+        pad = jnp.broadcast_to(FQ12.one(),
+                               (target - size,) + f.shape[1:]).astype(
+                                   jnp.int32)
+        f = jnp.concatenate([f, pad], axis=0)
+    while target > 1:
+        half = target // 2
+        f = FQ12.mul(f[:half], f[half:])
+        target = half
+    return f[0]
+
+
 def multi_pairing_product(px: Array, py: Array, skip: Array,
                           qx: Array, qy: Array) -> Array:
     """Π_i f_{|x|,Q_i}(P_i) over the LEADING pair axis, skipped lanes
@@ -151,20 +172,7 @@ def multi_pairing_product(px: Array, py: Array, skip: Array,
     every pair (vmapped by batching), then a log₂ tree of Fq12 muls."""
     f = miller_loop(px, py, qx, qy)
     f = FQ12.where(skip, FQ12.one_like(f), f)
-    pairs = f.shape[0]
-    size = 1
-    while size < pairs:
-        size *= 2
-    if size != pairs:
-        pad = jnp.broadcast_to(FQ12.one(),
-                               (size - pairs,) + f.shape[1:]).astype(
-                                   jnp.int32)
-        f = jnp.concatenate([f, pad], axis=0)
-    while size > 1:
-        half = size // 2
-        f = FQ12.mul(f[:half], f[half:])
-        size = half
-    return f[0]
+    return fq12_tree_product(f)
 
 
 def multi_pairing_is_one(px: Array, py: Array, p_inf: Array,

@@ -11,20 +11,28 @@ This is the DP analog named in SURVEY.md §2.3; sharding one MSM's point
 range across devices plays the role tensor parallelism plays in ML stacks.
 """
 
-from .multihost import global_mesh, init_multihost  # noqa: F401
+from .multihost import (  # noqa: F401
+    global_mesh,
+    host_shard_array,
+    init_multihost,
+)
 
 _SHARDED = (
     "make_mesh",
+    "sharded_final_is_one",
     "sharded_g1_validate_sum",
     "sharded_g2_sum_rows",
     "sharded_g2_validate",
+    "sharded_miller_partial_local",
+    "sharded_miller_product",
+    "sharded_multi_pairing_is_one",
     "sharded_round_step",
     "sharded_verify_round",
     "sharded_verify_round_local",
     "sharded_verify_round_multi",
 )
 
-__all__ = ["global_mesh", "init_multihost", *_SHARDED]
+__all__ = ["global_mesh", "host_shard_array", "init_multihost", *_SHARDED]
 
 
 def __getattr__(name):

@@ -95,3 +95,33 @@ def process_count() -> int:
     import jax
 
     return jax.process_count()
+
+
+def host_shard_array(mesh: Mesh, local, axis: str = "lanes",
+                     replicated: bool = False, spec=None):
+    """Per-host shard feeding for a (possibly multi-host) mesh: build
+    the global array from this process's local block via
+    jax.make_array_from_process_local_data, so a frontier flush is one
+    mesh dispatch instead of a per-host scatter.  Each host contributes
+    the lanes its local devices own (the batch axis sharded over
+    `axis`); replicated=True is for host-identical operands (masks,
+    row indices against the replicated pubkey cache), where every
+    process holds the full array.  An explicit `spec` (a
+    jax.sharding.PartitionSpec) overrides both for layouts the two
+    defaults can't express (e.g. a (k, B) mask sharded on axis 1).
+
+    Single-process meshes skip the ceremony: a plain device put is what
+    the jit's input resharding already handles, and it keeps the
+    single-chip and local-mesh hot paths byte-identical to before."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1:
+        return jnp.asarray(local)
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if spec is None:
+        spec = PartitionSpec() if replicated else PartitionSpec(axis)
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), np.asarray(local))

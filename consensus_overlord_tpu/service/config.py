@@ -74,6 +74,17 @@ class ConsensusConfig:
     #: "on"/"off" force it.  The host oracle stays the breaker-guarded
     #: fallback either way.
     device_pairing: str = "auto"
+    #: Crypto dispatch mesh (parallel/): "off" keeps the single-chip
+    #: kernel set (kernels see exactly one device); "local" shards
+    #: signature and pairing lanes over every device this process owns
+    #: (parallel.make_mesh — one host, ICI only); "global" first joins
+    #: the multi-host JAX runtime (parallel.init_multihost, the
+    #: JAX_COORDINATOR_ADDRESS/... triple) and builds the host-major
+    #: mesh over every device of every process
+    #: (parallel.multihost.global_mesh), so one frontier flush is one
+    #: mesh dispatch spanning ICI within hosts and one DCN stage across
+    #: them.
+    mesh: str = "off"
     #: Serve the verify relation's G2 MSM from per-pubkey precomputed
     #: window tables rebuilt on reconfigure (ops/curve.py
     #: msm_table_build; ~240 KB HBM per cached pubkey row).
@@ -166,6 +177,11 @@ class ConsensusConfig:
                 f"device_pairing must be auto|on|off, got "
                 f"{self.device_pairing!r} (a typo here would silently "
                 "keep the pairing on the host)")
+        if self.mesh not in ("off", "local", "global"):
+            raise ValueError(
+                f"mesh must be off|local|global, got {self.mesh!r} (a "
+                "typo here would silently fall back to the single-chip "
+                "kernel set)")
 
     @property
     def device_pairing_flag(self) -> Optional[bool]:

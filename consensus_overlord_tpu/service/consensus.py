@@ -60,12 +60,30 @@ def _make_crypto(backend: str, private_key: int,
             return TpuBlsCrypto(private_key)
         return TpuBlsCrypto(
             private_key,
+            mesh=_make_mesh(config.mesh),
             device_pairing=config.device_pairing_flag,
             g2_table_msm=config.g2_table_msm)
     if backend == "cpu":
         from ..crypto.provider import CpuBlsCrypto
         return CpuBlsCrypto(private_key)
     raise ValueError(f"unknown crypto_backend {backend!r}")
+
+
+def _make_mesh(mode: str):
+    """config.mesh → the TpuBlsCrypto `mesh` ctor arg.  "global" joins
+    the multi-host runtime FIRST (jax refuses after the backend
+    initializes — the parallel package keeps its kernel imports lazy for
+    exactly this ordering) and then spans every process's devices
+    host-major, so the combine all-gathers ride ICI within a host with
+    one DCN stage across hosts; in a single-process run it degenerates
+    to the same device set as "local"."""
+    if mode == "off":
+        return None
+    from .. import parallel
+    if mode == "global":
+        parallel.init_multihost()
+        return parallel.global_mesh()
+    return parallel.make_mesh()
 
 
 class Consensus:

@@ -32,9 +32,13 @@ sockets.
 CONSENSUS_PAD_MIN=2048 pins the frontier's batch rungs to one kernel
 shape (the same knob production deployments use, BASELINE.md r4 notes).
 
-Usage: python scripts/bench_round.py [N] [ROUNDS]
+Usage: python scripts/bench_round.py [N] [ROUNDS] [--mesh D]
 Emits one JSON line per scale with p50/p95, first-touch round, frontier
-batch stats, and follower QC-verify p50.
+batch stats, and follower QC-verify p50.  --mesh D runs the leader's
+provider over a D-lane virtual CPU mesh (forces the CPU platform; the
+device-count flag must precede jax's backend init, which is why it is
+parsed at module level) and emits the metric as mesh_round_p50_ms so
+the mesh rung trends as its own ledger family.
 """
 
 import asyncio
@@ -55,9 +59,19 @@ os.environ.setdefault("CONSENSUS_PK_CAP_MIN", "16384")
 # TPU-tunnel kernels are never persistently cached (executable
 # serialization is unsupported through the relay), so per-scale
 # processes would each re-pay the full kernel-set compile.
-SCALES = ([int(x) for x in sys.argv[1].split(",")] if len(sys.argv) > 1
-          else [1000])
-ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+MESH = int(sys.argv[sys.argv.index("--mesh") + 1]) \
+    if "--mesh" in sys.argv else 0
+if MESH:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{_flags} --xla_force_host_platform_device_count={MESH}"
+        ).strip()
+_pos = [a for a in sys.argv[1:] if not a.startswith("-")
+        and a != (sys.argv[sys.argv.index("--mesh") + 1]
+                  if "--mesh" in sys.argv else None)]
+SCALES = [int(x) for x in _pos[0].split(",")] if _pos else [1000]
+ROUNDS = int(_pos[1]) if len(_pos) > 1 else 20
 CONTENT = b"bench-round-block"
 
 
@@ -195,9 +209,10 @@ def pctl(xs, q):
 
 
 async def main():
-    if os.environ.get("CONSENSUS_BENCH_CPU"):  # smoke-test lane: the axon
-        import jax                             # plugin pins JAX_PLATFORMS,
-        jax.config.update("jax_platforms", "cpu")  # config overrides it
+    if MESH or os.environ.get("CONSENSUS_BENCH_CPU"):  # smoke lane: the
+        import jax                             # axon plugin pins
+        jax.config.update("jax_platforms", "cpu")  # JAX_PLATFORMS; the
+        # config override wins (and the virtual mesh is CPU-only)
     from consensus_overlord_tpu.compile_cache import enable
     enable()
     from consensus_overlord_tpu.core.types import Node
@@ -205,7 +220,14 @@ async def main():
 
     n_max = max(SCALES)
     pks, sigs, vote, vote_hash = fixture(n_max)
-    provider = TpuBlsCrypto(0xF00D, device_threshold=32)
+    mesh = None
+    if MESH:
+        from consensus_overlord_tpu.parallel import make_mesh
+
+        mesh = make_mesh(MESH)
+        print(f"mesh: {mesh.devices.size} lanes", file=sys.stderr,
+              flush=True)
+    provider = TpuBlsCrypto(0xF00D, device_threshold=32, mesh=mesh)
 
     # One fill for the whole run (smaller scales use a row prefix),
     # chunked to the pad floor so pubkey validation compiles ONE kernel
@@ -274,7 +296,10 @@ async def main():
         # per-scale line lands in BENCH_* artifacts and must
         # diff/trend like bench.py's record.
         print(json.dumps(ledger.annotate({
-            "metric": "consensus_round_p50_ms", "validators": n,
+            # The mesh rung is its own ledger family — see bench.py.
+            "metric": ("mesh_round_p50_ms" if MESH
+                       else "consensus_round_p50_ms"),
+            "validators": n, "mesh_devices": MESH,
             # Headline value/unit: the ledger's diff/check gates on
             # these (unit "ms" marks the metric lower-is-better).
             "value": round(pctl(lat, 0.5) * 1e3, 1), "unit": "ms",

@@ -8,9 +8,17 @@ Checks, per randomized (sig, pk, msg) set (half of them invalid):
   * the verdicts match the a-priori expectation (valid sets True,
     tampered sets False).
 
+--mesh D runs the SHARDED staged pair instead (parallel/sharded.py
+sharded_multi_pairing_is_one) over a D-lane virtual CPU mesh
+(--xla_force_host_platform_device_count, set before jax initializes):
+pair lanes shard across the mesh, each device Miller-loops its shard,
+the D Fq12 partials all-gather, and every device finishes the identical
+product + final exponentiation — the verdict must still be bit-identical
+to the host oracle.  Pairs pad up to the mesh size with masked lanes.
+
 Exit 0 on full agreement, 1 with a per-set report otherwise.
 
-Usage: python scripts/pairing_smoke.py [N]
+Usage: python scripts/pairing_smoke.py [N] [--mesh D]
 """
 
 import os
@@ -19,22 +27,55 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
+_args = [a for a in sys.argv[1:] if not a.startswith("-")]
+N = int(_args[0]) if _args else 4
+MESH = 0
+if "--mesh" in sys.argv:
+    MESH = int(sys.argv[sys.argv.index("--mesh") + 1])
+    # Virtual devices: the flag must land before the CPU backend
+    # initializes — before ANY jax import (compile_cache pulls jax in).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={MESH}"
+        ).strip()
+
 from consensus_overlord_tpu.compile_cache import enable
 
 enable()
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+if MESH:
+    jax.config.update("jax_platforms", "cpu")
 
 from consensus_overlord_tpu.core.sm3 import sm3_hash
 from consensus_overlord_tpu.crypto import bls12381 as oracle
 from consensus_overlord_tpu.ops import pairing as pr
 
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+
+def _verdict_fn():
+    """The device verdict under test: the single-chip staged pair, or
+    the sharded mesh pair under --mesh (same verdict contract)."""
+    if not MESH:
+        return pr.multi_pairing_is_one_staged
+    from consensus_overlord_tpu.parallel import (
+        make_mesh,
+        sharded_multi_pairing_is_one,
+    )
+
+    mesh = make_mesh(MESH)
+    assert mesh.devices.size == MESH, \
+        f"virtual mesh has {mesh.devices.size} devices, wanted {MESH}"
+    return sharded_multi_pairing_is_one(mesh)
 
 
 def main() -> int:
     neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+    verdict = _verdict_fn()
+    lanes = MESH or 1
     failures = 0
     for i in range(N):
         sk = 0xC0FFEE + 31 * i
@@ -46,21 +87,27 @@ def main() -> int:
         h_pt = oracle.hash_to_g1(h, b"")
         want = i % 2 == 0
 
-        px, py, pinf = pr.g1_affine_from_oracle([sig, h_pt])
-        qx, qy, qinf = pr.g2_affine_from_oracle([neg_g2, pk])
-        got = bool(pr.multi_pairing_is_one_staged(
+        # Pad the 2-pair set up to a lanes multiple with masked lanes
+        # (the provider's ladder does the same on the mesh path).
+        size = -(-2 // lanes) * lanes
+        pad = [None] * (size - 2)
+        px, py, pinf = pr.g1_affine_from_oracle([sig, h_pt] + pad)
+        qx, qy, qinf = pr.g2_affine_from_oracle([neg_g2, pk] + pad)
+        mask = np.arange(size) < 2
+        got = bool(verdict(
             jnp.asarray(px), jnp.asarray(py), jnp.asarray(pinf),
             jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(qinf),
-            jnp.asarray(np.ones(2, bool))))
+            jnp.asarray(mask)))
         host = oracle.multi_pairing_is_one([(sig, neg_g2), (h_pt, pk)])
         ok = got == host == want
         print(f"set {i}: device={got} host={host} expected={want}"
               f" {'OK' if ok else 'MISMATCH'}", flush=True)
         failures += 0 if ok else 1
+    kind = f"mesh({MESH})" if MESH else "device"
     if failures:
         print(f"FAIL: {failures}/{N} sets disagree")
         return 1
-    print(f"ok: {N}/{N} device verdicts identical to the host oracle")
+    print(f"ok: {N}/{N} {kind} verdicts identical to the host oracle")
     return 0
 
 

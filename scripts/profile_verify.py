@@ -13,11 +13,16 @@ provider's own instrumentation):
   pairing    — host 2-pairing batch check (native backend if built)
 
 --sharded-probe adds the mesh stage split (per-device partial reduce vs
-ICI all-gather, TpuBlsCrypto.profile_sharded_stages); --profile-dir
-captures an XLA trace of one measured batch through ProfileSession.
+ICI all-gather, plus the pairing partial-vs-combine split,
+TpuBlsCrypto.profile_sharded_stages); --profile-dir captures an XLA
+trace of one measured batch through ProfileSession.  --mesh D profiles
+the provider's MESH kernel set over a D-lane virtual CPU mesh
+(--xla_force_host_platform_device_count — set before jax initializes,
+which is why this script imports jax only inside main), so the
+device-pairing + sharded numbers come from the production mesh path.
 
 Usage:  python scripts/profile_verify.py [N] [--iters K] [--json]
-            [--cpu] [--sharded-probe] [--profile-dir DIR]
+            [--cpu] [--mesh D] [--sharded-probe] [--profile-dir DIR]
 
 Emits one {"metric": ...} JSON line on stdout (the bench_round.py
 contract; human-readable stage lines go to stderr), so CI can smoke-run
@@ -75,6 +80,9 @@ def main() -> int:
                     "lines)")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU lanes (the CI smoke configuration)")
+    ap.add_argument("--mesh", type=int, default=0, metavar="D",
+                    help="profile the mesh kernel set over a D-lane "
+                    "virtual CPU mesh (implies --cpu)")
     ap.add_argument("--sharded-probe", action="store_true",
                     help="also run the mesh stage probe (partial-reduce "
                     "vs all-gather split; compiles two extra kernels)")
@@ -83,6 +91,17 @@ def main() -> int:
                     "into this directory (ProfileSession)")
     args = ap.parse_args()
 
+    if args.mesh:
+        # Virtual-device mesh: the flag must be in place before the XLA
+        # CPU backend initializes, hence before ANY jax import below.
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.mesh}").strip()
+        args.cpu = True
     if args.cpu:
         import jax
 
@@ -103,7 +122,14 @@ def main() -> int:
     say(f"device: {platform}  N={n}")
 
     sigs, h, pks = _fixture(n)
-    provider = tp.TpuBlsCrypto(0xA11CE, device_threshold=min(8, n))
+    mesh = None
+    if args.mesh:
+        from consensus_overlord_tpu.parallel import make_mesh
+
+        mesh = make_mesh(args.mesh)
+        say(f"mesh: {mesh.devices.size} lanes")
+    provider = tp.TpuBlsCrypto(0xA11CE, device_threshold=min(8, n),
+                               mesh=mesh)
     provider.update_pubkeys(pks)
 
     # Warm rep absorbs the kernel compile UNMETERED (it would dominate
@@ -150,6 +176,10 @@ def main() -> int:
             f"{sharded['partial_reduce_s'] * 1e3:9.2f} ms  "
             f"({sharded['devices']} device(s))")
         say(f"{'allgather':12s} {sharded['allgather_s'] * 1e3:9.2f} ms")
+        say(f"{'pair_partial':12s} "
+            f"{sharded['pairing_partial_s'] * 1e3:9.2f} ms")
+        say(f"{'pair_combine':12s} "
+            f"{sharded['pairing_combine_s'] * 1e3:9.2f} ms")
 
     from consensus_overlord_tpu.obs import ledger
 
@@ -170,6 +200,7 @@ def main() -> int:
         "stages_ms": stages_ms,
         "device_pairing": provider._pairing_on_device,
         "pairing_host_fallbacks": provider.pairing_host_fallbacks,
+        "mesh_devices": mesh.devices.size if mesh is not None else 0,
         "occupancy": summary["occupancy"],
         "devices": summary["devices"],
         "sharded": sharded,

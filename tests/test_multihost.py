@@ -48,6 +48,35 @@ def test_global_mesh_matches_make_mesh_shape():
     assert m1.devices.size == m2.devices.size
 
 
+def test_provider_verdicts_identical_over_global_mesh():
+    """Single-process degenerate equivalence: a provider built over
+    global_mesh() (the multi-host launcher's mesh, host-major) must
+    return the same verify_batch verdicts — device pairing included —
+    as one over make_mesh().  With one process the two meshes contain
+    the same devices, so any divergence is a sharding-layout bug in the
+    kernel set, not a DCN effect."""
+    from consensus_overlord_tpu.core.sm3 import sm3_hash
+    from consensus_overlord_tpu.crypto import bls12381 as oracle
+    from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+
+    batch = 16
+    h = sm3_hash(b"global-mesh-degenerate")
+    sks = [7000 + 13 * i for i in range(batch)]
+    sigs = [oracle.sign(sk, h) for sk in sks]
+    pks = [oracle.sk_to_pk(sk) for sk in sks]
+    sigs[5] = oracle.sign(sks[5], sm3_hash(b"tampered"))
+
+    verdicts = []
+    for mesh in (global_mesh(), make_mesh()):
+        provider = TpuBlsCrypto(0xD1CE, device_threshold=1, mesh=mesh,
+                                device_pairing=True)
+        provider.update_pubkeys(pks)
+        got = provider.verify_batch(sigs, [h] * batch, pks)
+        assert provider.pairing_host_fallbacks == 0
+        verdicts.append(got)
+    assert verdicts[0] == verdicts[1] == [i != 5 for i in range(batch)]
+
+
 @pytest.mark.slow
 def test_two_process_dcn_verify_round():
     """Two OS processes × 2 virtual CPU devices join one

@@ -125,3 +125,186 @@ def test_provider_over_mesh_end_to_end():
     assert agg == oracle.g1_compress(want)
     assert provider.verify_aggregated_signature(agg, h, pks)
     assert not provider.verify_aggregated_signature(agg, sm3_hash(b"x"), pks)
+
+
+# ---------------------------------------------------------------------------
+# Sharded pairing (r14): the mesh path's device verdict vs the host oracle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh_pairing_provider():
+    """A provider whose kernel set is the 8-device mesh WITH the sharded
+    staged pairing on — the production mesh hot path under test."""
+    from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+
+    mesh = make_mesh(8)
+    provider = TpuBlsCrypto(0xD1CE, device_threshold=1, mesh=mesh,
+                            device_pairing=True)
+    sks = [7000 + 13 * i for i in range(B)]
+    provider.update_pubkeys([oracle.sk_to_pk(sk) for sk in sks])
+    return provider, sks
+
+
+class TestShardedPairingKernels:
+    """parallel/sharded.py sharded_multi_pairing_is_one directly: verdict
+    bit-identity vs crypto/bls12381.py multi_pairing_is_one over the
+    8-device mesh, valid + invalid + padding lanes."""
+
+    def _verdict(self, fn, pairs, size):
+        """Run `fn` on `pairs` padded up to `size` with masked lanes."""
+        from consensus_overlord_tpu.ops import pairing as pr
+
+        pad = [None] * (size - len(pairs))
+        px, py, pinf = pr.g1_affine_from_oracle(
+            [p for p, _q in pairs] + pad)
+        qx, qy, qinf = pr.g2_affine_from_oracle(
+            [q for _p, q in pairs] + pad)
+        mask = np.arange(size) < len(pairs)
+        return bool(fn(jnp.asarray(px), jnp.asarray(py),
+                       jnp.asarray(pinf), jnp.asarray(qx),
+                       jnp.asarray(qy), jnp.asarray(qinf),
+                       jnp.asarray(mask)))
+
+    def test_verdict_identity_valid_invalid_padding(self):
+        from consensus_overlord_tpu.core.sm3 import sm3_hash
+        from consensus_overlord_tpu.parallel import (
+            sharded_multi_pairing_is_one)
+
+        mesh = make_mesh(8)
+        fn = sharded_multi_pairing_is_one(mesh)
+        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+        for i in range(4):
+            sk = RNG.randrange(2, oracle.R)
+            h = sm3_hash(b"mesh-pairing-%d" % i)
+            sig = oracle.g1_decompress(oracle.sign(sk, h))
+            pk = oracle.g2_decompress(oracle.sk_to_pk(sk))
+            if i % 2 == 1:
+                sig = oracle.g1_mul(sig, 7)  # forged: valid point, wrong sig
+            h_pt = oracle.hash_to_g1(h, b"")
+            pairs = [(sig, neg_g2), (h_pt, pk)]
+            got = self._verdict(fn, pairs, 8)  # 6 padding lanes
+            host = oracle.multi_pairing_is_one(pairs)
+            assert got is host is (i % 2 == 0)
+
+    def test_infinity_pairs_skip_like_host(self):
+        """An infinity input skips its lane on device exactly as the
+        host's None pairs do — over the mesh, with padding live too."""
+        from consensus_overlord_tpu.core.sm3 import sm3_hash
+        from consensus_overlord_tpu.parallel import (
+            sharded_multi_pairing_is_one)
+
+        mesh = make_mesh(8)
+        fn = sharded_multi_pairing_is_one(mesh)
+        neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
+        sk = RNG.randrange(2, oracle.R)
+        h = sm3_hash(b"mesh-pairing-inf")
+        sig = oracle.g1_decompress(oracle.sign(sk, h))
+        pk = oracle.g2_decompress(oracle.sk_to_pk(sk))
+        h_pt = oracle.hash_to_g1(h, b"")
+        pairs = [(sig, neg_g2), (h_pt, pk), (None, pk), (h_pt, None)]
+        got = self._verdict(fn, pairs, 8)
+        host = oracle.multi_pairing_is_one(
+            [(sig, neg_g2), (h_pt, pk), (None, pk), (h_pt, None)])
+        assert got is host is True
+
+
+class TestMeshConfigKnob:
+    """service/config.py `mesh` knob → service/consensus._make_mesh →
+    the provider's kernel-set selection."""
+
+    def test_values_validate(self):
+        from consensus_overlord_tpu.service.config import ConsensusConfig
+        for mode in ("off", "local", "global"):
+            assert ConsensusConfig(mesh=mode).mesh == mode
+        with pytest.raises(ValueError):
+            ConsensusConfig(mesh="ici")
+
+    def test_make_mesh_modes(self):
+        from consensus_overlord_tpu.service.consensus import _make_mesh
+        assert _make_mesh("off") is None
+        local = _make_mesh("local")
+        assert local is not None and local.devices.size == len(jax.devices())
+        # single process: "global" degenerates to the same device set
+        # (init_multihost returns False without a coordinator)
+        glob = _make_mesh("global")
+        assert glob.devices.size == local.devices.size
+
+
+class TestMeshProviderPairing:
+    """The provider surface on the mesh path with device pairing on —
+    the single-chip suite's contracts (tests/test_pairing.py
+    TestProviderDevicePairing) must hold unchanged over the mesh."""
+
+    def test_verify_batch_exact_no_fallbacks(self, mesh_pairing_provider):
+        from consensus_overlord_tpu.core.sm3 import sm3_hash
+
+        provider, sks = mesh_pairing_provider
+        h = sm3_hash(b"mesh-dev-pairing-1")
+        sigs = [oracle.sign(sk, h) for sk in sks]
+        pks = [oracle.sk_to_pk(sk) for sk in sks]
+        sigs[2] = oracle.sign(sks[2], sm3_hash(b"wrong"))
+        got = provider.verify_batch(sigs, [h] * B, pks)
+        assert got == [i != 2 for i in range(B)]
+        assert provider.pairing_host_fallbacks == 0
+
+    def test_one_final_exp_per_flush_on_mesh(self, mesh_pairing_provider):
+        """pairing stage count == flush count over the mesh: the sharded
+        staged pair still pays ONE shared final exponentiation per
+        frontier flush, never one per signature."""
+        from consensus_overlord_tpu.core.sm3 import sm3_hash
+        from consensus_overlord_tpu.obs.prof import DeviceProfiler
+
+        provider, sks = mesh_pairing_provider
+        prof = DeviceProfiler()
+        provider.bind_profiler(prof)
+        try:
+            h = sm3_hash(b"mesh-dev-pairing-flushes")
+            sigs = [oracle.sign(sk, h) for sk in sks]
+            pks = [oracle.sk_to_pk(sk) for sk in sks]
+            flushes = 3
+            for _ in range(flushes):
+                assert all(provider.verify_batch(sigs, [h] * B, pks))
+            totals = prof.stage_totals()
+            assert totals["verify_batch/pairing"]["count"] == flushes
+            assert totals["verify_batch/readback"]["count"] == flushes
+        finally:
+            provider.bind_profiler(None)
+        assert provider.pairing_host_fallbacks == 0
+
+    def test_multi_hash_fused_on_mesh(self, mesh_pairing_provider):
+        from consensus_overlord_tpu.core.sm3 import sm3_hash
+
+        provider, sks = mesh_pairing_provider
+        h1, h2 = sm3_hash(b"mesh-mh-a"), sm3_hash(b"mesh-mh-b")
+        hashes = [h1 if i % 2 == 0 else h2 for i in range(B)]
+        sigs = [oracle.sign(sks[i], hashes[i]) for i in range(B)]
+        pks = [oracle.sk_to_pk(sk) for sk in sks]
+        assert provider.verify_batch(sigs, hashes, pks) == [True] * B
+        assert provider.pairing_host_fallbacks == 0
+
+    def test_injected_fault_breaker_host_fallback(self, monkeypatch):
+        """A device fault on the MESH pairing dispatch degrades exactly
+        like the single-chip path: breaker fed, fallback counted, host
+        oracle verdicts exact."""
+        from consensus_overlord_tpu.core.sm3 import sm3_hash
+        from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+
+        mesh = make_mesh(8)
+        t = TpuBlsCrypto(0xD1CE, device_threshold=1, mesh=mesh,
+                         device_pairing=True)
+        sks = [7000 + 13 * i for i in range(B)]
+        pks = [oracle.sk_to_pk(sk) for sk in sks]
+        t.update_pubkeys(pks)
+
+        def boom(*_a):
+            raise RuntimeError("injected mesh pairing fault")
+
+        monkeypatch.setattr(t._kernels, "multi_pairing", boom)
+        h = sm3_hash(b"mesh-fault-pairing")
+        sigs = [oracle.sign(sk, h) for sk in sks]
+        sigs[4] = oracle.sign(sks[4], sm3_hash(b"nope"))
+        got = t.verify_batch(sigs, [h] * B, pks)
+        assert got == [i != 4 for i in range(B)]
+        assert t.pairing_host_fallbacks >= 1
+        assert t.breaker.status()["state"] != "open"  # one fault ≠ open
+        assert t.degraded_status()["pairing_host_fallbacks"] >= 1

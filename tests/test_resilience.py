@@ -228,6 +228,9 @@ class FlakyKernels:
         self.real = real
         self.fail = True
         self.calls = 0
+        # operand feeding is not a device dispatch — never gated
+        self.ship = real.ship
+        self.ship_replicated = real.ship_replicated
 
     def _gate(self, name, *a):
         self.calls += 1
