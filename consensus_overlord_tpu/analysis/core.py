@@ -172,6 +172,10 @@ class Project:
                     every rule's default globs)
       obs_metrics / obs_readme / service_main / sim_chaos
                     structural-rule target paths (repo-relative)
+      statusz_files tuple of files whose add_status_source() calls form
+                    the /statusz section union (OBS001 axis c; default
+                    service/main.py + sim/run.py — service_main narrows
+                    to one file when statusz_files is absent)
       search_roots  dirs scanned for metric references (OBS001 axis b)
     """
 

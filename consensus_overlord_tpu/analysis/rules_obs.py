@@ -12,8 +12,10 @@ rule existed.  Three axes, all bidirectional where both sides exist:
       somewhere outside obs/metrics.py (package or tests) — a family
       nobody observes or asserts is dead weight on every scrape
   (c) /statusz sections registered via add_status_source() in
-      service/main.py  ⇔  top-level keys of the documented /statusz
-      schema block in obs/README.md
+      service/main.py OR sim/run.py (the union — the sim registers
+      sim-only sections like "router" on the same exporter surface)
+      ⇔  top-level keys of the documented /statusz schema block in
+      obs/README.md
 """
 
 from __future__ import annotations
@@ -28,6 +30,7 @@ from .core import Finding, Project
 OBS_METRICS = "consensus_overlord_tpu/obs/metrics.py"
 OBS_README = "consensus_overlord_tpu/obs/README.md"
 SERVICE_MAIN = "consensus_overlord_tpu/service/main.py"
+SIM_RUN = "consensus_overlord_tpu/sim/run.py"
 
 _METRIC_CTORS = ("Histogram", "Counter", "Gauge", "Summary", "Info")
 
@@ -101,19 +104,24 @@ def _statusz_documented(readme_text: str) -> Dict[str, int]:
     return out
 
 
-def _statusz_registered(project: Project, main_rel: str
-                        ) -> Dict[str, int]:
-    sf = project.file(main_rel)
-    if sf is None or sf.tree is None:
-        return {}
-    out: Dict[str, int] = {}
-    for node in ast.walk(sf.tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "add_status_source"
-                and node.args and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)):
-            out.setdefault(node.args[0].value, node.lineno)
+def _statusz_registered(project: Project, rels: Iterable[str]
+                        ) -> Dict[str, Tuple[str, int]]:
+    """{section: (file, lineno)} over every add_status_source() call in
+    the given files (first registration wins) — the union of the
+    service and sim exporter surfaces."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for rel in rels:
+        sf = project.file(rel)
+        if sf is None or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_status_source"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                out.setdefault(node.args[0].value, (rel, node.lineno))
     return out
 
 
@@ -143,7 +151,13 @@ def check_obs001(project: Project) -> Iterable[Finding]:
     ov = project.overrides
     metrics_rel = ov.get("obs_metrics", OBS_METRICS)
     readme_rel = ov.get("obs_readme", OBS_README)
-    main_rel = ov.get("service_main", SERVICE_MAIN)
+    statusz_rels = ov.get("statusz_files")
+    if statusz_rels is None:
+        # Back-compat: a bare service_main override narrows the scan to
+        # that one file (the pre-fleet shape the fixtures use).
+        main_rel = ov.get("service_main")
+        statusz_rels = ((main_rel,) if main_rel
+                        else (SERVICE_MAIN, SIM_RUN))
     roots = ov.get("search_roots",
                    ("consensus_overlord_tpu", "tests"))
 
@@ -194,22 +208,22 @@ def check_obs001(project: Project) -> Iterable[Finding]:
                 "tests) — dead weight on every scrape")
 
     # (c) statusz sections ⇔ documented schema keys
-    reg_sections = _statusz_registered(project, main_rel)
+    reg_sections = _statusz_registered(project, statusz_rels)
     doc_sections = _statusz_documented(readme_text)
     if reg_sections and doc_sections:
-        main_sf = project.file(main_rel)
-        for name, lineno in sorted(reg_sections.items()):
+        for name, (rel, lineno) in sorted(reg_sections.items()):
             if name not in doc_sections:
-                yield main_sf.finding(
-                    "OBS001", lineno,
+                yield Finding(
+                    "OBS001", rel, lineno,
                     f"/statusz section \"{name}\" is registered here "
                     f"but missing from the {readme_rel} schema block")
         for name, line in sorted(doc_sections.items()):
             if name not in reg_sections and name not in _STATUSZ_BUILTIN:
                 yield Finding(
                     "OBS001", readme_rel, line,
-                    f"/statusz schema documents \"{name}\" but "
-                    f"{main_rel} never registers that section",
+                    f"/statusz schema documents \"{name}\" but no "
+                    f"exporter surface ({', '.join(statusz_rels)}) "
+                    "registers that section",
                     snippet=f'"{name}"')
 
 

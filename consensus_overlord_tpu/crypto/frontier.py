@@ -79,7 +79,7 @@ class BatchingVerifier(TenantLane):
                  linger_s: float = 0.002, metrics=None,
                  max_pending: int = DEFAULT_QUEUE_BOUND,
                  tenant_id: str = "default", weight: int = 1,
-                 priority_lanes: bool = True):
+                 priority_lanes: bool = True, recorder=None):
         if max_pending < max_batch:
             # The config layer rejects this too; direct constructions
             # (bench scripts, sim harness) must hit the same wall.  A
@@ -92,7 +92,8 @@ class BatchingVerifier(TenantLane):
                 f"max_pending ({max_pending}) must be >= max_batch "
                 f"({max_batch}) for a single-tenant frontier")
         core = SharedFrontier(provider, max_batch=max_batch,
-                              linger_s=linger_s, metrics=metrics)
+                              linger_s=linger_s, metrics=metrics,
+                              recorder=recorder)
         super().__init__(core, tenant_id, weight=weight,
                          queue_bound=max_pending,
                          priority_lanes=priority_lanes)

@@ -62,6 +62,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ..core.sm3 import sm3_hash
 from ..core.types import SignedChoke, SignedProposal, SignedVote
+from ..obs.fleet import next_round_id, tag_round
 from ..obs.prof import annotate
 
 logger = logging.getLogger("consensus_overlord_tpu.tenancy")
@@ -282,11 +283,15 @@ class SharedFrontier:
     """
 
     def __init__(self, provider, max_batch: int = 1024,
-                 linger_s: float = 0.002, metrics=None):
+                 linger_s: float = 0.002, metrics=None, recorder=None):
         self._provider = provider
         self._max_batch = int(max_batch)
         self._linger = linger_s
         self._metrics = metrics
+        #: Optional obs.FlightRecorder: each flush records a
+        #: `round_flush` event carrying the round id the dispatch is
+        #: tagged with (obs/fleet.py) — the waterfall's anchor event.
+        self._recorder = recorder
         self._lanes: Dict[str, TenantLane] = {}
         #: Registration order = DWRR rotation order; the start position
         #: advances every flush so no tenant owns the batch head.
@@ -604,6 +609,18 @@ class SharedFrontier:
         hashes = [b[1] for b in batch]
         voters = [b[2] for b in batch]
         m = self._metrics
+        # One round id per flush: the dispatcher thread is tagged with
+        # it (a thread-local — run_in_executor does not carry
+        # contextvars), so every StagedCall / per-device sample /
+        # flightrec event this flush produces joins on it
+        # (scripts/waterfall.py).
+        round_id = next_round_id()
+        if self._recorder is not None:
+            now = time.perf_counter()
+            oldest = min((b[5] for b in batch), default=now)
+            self._recorder.record(
+                "round_flush", round_id=round_id, batch=len(batch),
+                queue_wait_s=round(max(now - oldest, 0.0), 6))
         self._account_batch(batch)
         if m is not None:
             # Batch size only; padded-rung occupancy is observed by the
@@ -622,13 +639,24 @@ class SharedFrontier:
                 # dispatch→readback round-trip of a remote PJRT link
                 # with device compute.
                 loop = asyncio.get_running_loop()
+
+                def _dispatch():
+                    with tag_round(round_id):
+                        return verify_async(sigs, hashes, voters)
+
                 t0 = time.perf_counter()
                 with annotate("frontier.flush"):
                     resolver = await loop.run_in_executor(
-                        self._dispatcher, verify_async, sigs, hashes,
-                        voters)
+                        self._dispatcher, _dispatch)
                 t1 = time.perf_counter()
-                results = await asyncio.to_thread(resolver)
+
+                def _resolve():
+                    # Readback/pairing (and the throttled per-device
+                    # skew sample) run here — same round tag.
+                    with tag_round(round_id):
+                        return resolver()
+
+                results = await asyncio.to_thread(_resolve)
                 if m is not None:
                     # frontier_* phases are wrappers AROUND the provider's
                     # prep/dispatch/readback/pairing phases (they include
@@ -643,9 +671,13 @@ class SharedFrontier:
                         (t2 - t1) * 1000.0)
             else:
                 # Device dispatch blocks; keep the event loop live.
+                def _verify():
+                    with tag_round(round_id):
+                        return self._provider.verify_batch(sigs, hashes,
+                                                           voters)
+
                 t0 = time.perf_counter()
-                results = await asyncio.to_thread(
-                    self._provider.verify_batch, sigs, hashes, voters)
+                results = await asyncio.to_thread(_verify)
                 if m is not None:
                     m.crypto_dispatch_ms.labels(
                         phase="frontier_resolve").observe(

@@ -52,6 +52,7 @@ import numpy as np
 
 from ..compile_cache import enable as _enable_compile_cache
 from ..core.sm3 import sm3_hash
+from ..obs.fleet import current_round_id
 from ..obs.prof import NULL_CALL, annotate
 from .breaker import CircuitBreaker
 
@@ -489,6 +490,12 @@ class TpuBlsCrypto:
         #: Cached collective-free twin of the mesh verify kernel
         #: (profile_sharded_stages probe) — built on first probe.
         self._stage_probe = None
+        #: Chaos hook: {device_name: seconds} of synthetic delay added
+        #: inside the per-device shard-fetch timing loop — the seeded
+        #: fault injection the straggler detector's tests and the
+        #: nightly fleet-obs lane use (inject_straggler()).  Empty in
+        #: production.
+        self._inject_straggler: dict = {}
         #: Device circuit breaker: consulted before every device
         #: dispatch, reported to after every resolve.  An open breaker
         #: means this provider is in degraded mode — exact results from
@@ -618,7 +625,21 @@ class TpuBlsCrypto:
         call.observe(self._STAGE_OF.get(phase, phase), now - t0)
         return now
 
-    def _shard_latencies(self, sharded_out, sampled: bool = False) -> None:
+    def inject_straggler(self, device: str, seconds: float) -> None:
+        """Chaos hook: add `seconds` of synthetic delay to `device`'s
+        timed shard fetches (seconds <= 0 clears it).  The injected
+        sleep sits INSIDE the per-device timing window, so the
+        straggler detector sees exactly what a degraded D2H path would
+        produce — the seeded fault the tests and the nightly
+        fleet-obs-smoke lane assert on."""
+        device = str(device)
+        if seconds > 0:
+            self._inject_straggler[device] = float(seconds)
+        else:
+            self._inject_straggler.pop(device, None)
+
+    def _shard_latencies(self, sharded_out, sampled: bool = False,
+                         stage: str = "readback") -> None:
         """Per-device fetch timing on a sharded output (the validity
         mask, sharded P(lanes)) AFTER the result is complete: with
         compute already drained, each shard's blocking fetch measures
@@ -628,7 +649,12 @@ class TpuBlsCrypto:
         are THROTTLED through the profiler's sample interval — and run
         after the readback stage is observed, never inside it; only the
         explicit probe (profile_sharded_stages) passes sampled=True to
-        bypass the throttle."""
+        bypass the throttle.  `stage` names the mesh stage this output
+        attributes per device ('readback' on the hot path;
+        'partial_reduce' / 'pairing_partial' from the probe's
+        collective-free twins) — each sample lands in
+        sharded_device_stage_seconds{device,stage} and the attached
+        StragglerDetector via DeviceProfiler.device_stage."""
         if self.prof is None:
             return
         if not sampled:
@@ -639,12 +665,20 @@ class TpuBlsCrypto:
             if not self.prof.want_device_sample():
                 return
         try:
+            round_id = current_round_id()
+            device_stage = getattr(self.prof, "device_stage", None)
             for shard in sharded_out.addressable_shards:
+                name = f"{shard.device.platform}:{shard.device.id}"
+                delay = self._inject_straggler.get(name)
                 t0 = time.perf_counter()
+                if delay:
+                    time.sleep(delay)
                 np.asarray(shard.data)
-                self.prof.device_latency(
-                    f"{shard.device.platform}:{shard.device.id}",
-                    time.perf_counter() - t0)
+                seconds = time.perf_counter() - t0
+                if device_stage is not None:
+                    device_stage(name, stage, seconds, round_id=round_id)
+                else:  # pre-fleet profiler object: keep the r05 gauge
+                    self.prof.device_latency(name, seconds)
         # graftlint: disable=CONC002 -- profiling-only D2H sample: the
         # real readback already succeeded and fed the breaker above;
         # a failed skew sample must never affect crypto results.
@@ -1361,25 +1395,37 @@ class TpuBlsCrypto:
             jax.block_until_ready(pair_full_fn(*pair_args))
         t0 = time.perf_counter()
         with annotate("tpu_bls.probe.pairing_partial"):
-            jax.block_until_ready(pair_local_fn(*pair_args))
+            pair_local_out = pair_local_fn(*pair_args)
+            jax.block_until_ready(pair_local_out)
         t_pair_local = time.perf_counter() - t0
         t0 = time.perf_counter()
         with annotate("tpu_bls.probe.pairing_full"):
             jax.block_until_ready(pair_full_fn(*pair_args))
         t_pair_full = time.perf_counter() - t0
         t_pair_combine = max(t_pair_full - t_pair_local, 0.0)
+        device_stage_s = None
         if self.prof is not None:
             self.prof.sharded("partial_reduce", t_local)
             self.prof.sharded("allgather", t_combine)
             self.prof.sharded("pairing_partial", t_pair_local)
             self.prof.sharded("pairing_combine", t_pair_combine)
-            self._shard_latencies(local_out[2], sampled=True)
+            # Per-device attribution: the twins' outputs are still
+            # sharded, so each stage gets its own shard-fetch pass
+            # (plus the hot path's readback rows already recorded).
+            self._shard_latencies(local_out[2], sampled=True,
+                                  stage="partial_reduce")
+            self._shard_latencies(pair_local_out, sampled=True,
+                                  stage="pairing_partial")
+            totals = getattr(self.prof, "device_stage_totals", None)
+            if totals is not None:
+                device_stage_s = totals()
         return {"devices": int(lanes), "batch": n, "padded": int(size),
                 "partial_reduce_s": t_local, "allgather_s": t_combine,
                 "pairing_partial_s": t_pair_local,
                 "pairing_combine_s": t_pair_combine,
                 "pairing_full_s": t_pair_full,
-                "full_s": t_full}
+                "full_s": t_full,
+                "device_stage_s": device_stage_s}
 
     @staticmethod
     def _lane_hashes(groups: Dict[bytes, List[int]], n: int) -> List[bytes]:

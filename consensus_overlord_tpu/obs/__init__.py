@@ -29,6 +29,15 @@ src/main.rs:96, 111, 137).  Here:
                  compile-cache ratio, breaker state, occupancy) every N
                  seconds into a ring + optional JSONL — the soak lane's
                  drift detector and the /statusz "trend" section
+  fleet.py     — fleet observability: round-id tagging (frontier flush →
+                 dispatch → verdict), StragglerDetector (per-device
+                 rolling-median skew → mesh_straggler_total + the
+                 /statusz "mesh" section), FleetAggregator (cross-host
+                 trend merge → the /statusz "fleet" section)
+  anomaly.py   — AnomalyDetector: EWMA/z-score alerting over the
+                 telemetry series (occupancy collapse, stage-time
+                 spike, shed storm, straggler persistence) →
+                 obs_alerts_total{kind} + the /statusz "alerts" section
   logctx.py    — logging init from LogConfig + W3C traceparent extraction
                  from gRPC metadata into contextvars, stamped onto every
                  log record (the `set_parent` analog); per-request server
@@ -58,6 +67,12 @@ _EXPORTS = {
     "annotate": "prof",
     "TelemetrySampler": "telemetry",
     "drift_check": "telemetry",
+    "FleetAggregator": "fleet",
+    "StragglerDetector": "fleet",
+    "current_round_id": "fleet",
+    "next_round_id": "fleet",
+    "tag_round": "fleet",
+    "AnomalyDetector": "anomaly",
     "JaegerExporter": "tracing",
     "Span": "tracing",
 }

@@ -47,6 +47,13 @@ multiple nodes can live in one test process):
              — the device set a provider dispatches to;
              device_last_dispatch_seconds{device} — per-device shard
              readback latency (skew across a v4-8 slice)
+  fleet      sharded_device_stage_seconds{device,stage} — per-device
+             mesh stage attribution (the shard-fetch machinery
+             generalized to every stage); mesh_straggler_total
+             {device,stage} — rolling-median straggler flags
+             (obs/fleet.py StragglerDetector); obs_alerts_total{kind}
+             — EWMA/z-score anomaly alerts over the telemetry series
+             (obs/anomaly.py AnomalyDetector)
   engine     consensus_round_duration_ms, consensus_view_changes_total
              {reason}, consensus_chokes_sent_total,
              consensus_committed_heights_total,
@@ -240,6 +247,29 @@ class Metrics:
             "sharded dispatch, measured after the result completed "
             "(each gauge is one device's D2H path; a straggling chip "
             "is the outlier)", ["device"], registry=self.registry)
+
+        # -- fleet observability (obs/fleet.py + obs/anomaly.py) ----------
+        self.sharded_device_stage_seconds = Histogram(
+            "sharded_device_stage_seconds",
+            "Per-device stage timing of a mesh dispatch (the shard-"
+            "fetch machinery generalized to every stage: readback on "
+            "the hot path, partial_reduce / pairing_partial on the "
+            "sharded probe) — the per-chip attribution the aggregate "
+            "stage histograms lack",
+            ["device", "stage"], buckets=STAGE_SECONDS_BUCKETS,
+            registry=self.registry)
+        self.mesh_straggler_total = Counter(
+            "mesh_straggler_total",
+            "Straggler flags: a device whose rolling-median stage time "
+            "exceeded the mesh median by the configured ratio "
+            "(obs/fleet.py StragglerDetector)",
+            ["device", "stage"], registry=self.registry)
+        self.obs_alerts_total = Counter(
+            "obs_alerts_total",
+            "Anomaly alerts raised over the telemetry series, by kind "
+            "(occupancy_collapse / stage_time_spike / shed_storm / "
+            "straggler_persistence — obs/anomaly.py)",
+            ["kind"], registry=self.registry)
 
         # -- engine (engine/smr.py) ---------------------------------------
         self.round_duration_ms = Histogram(

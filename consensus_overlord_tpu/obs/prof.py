@@ -54,6 +54,8 @@ from collections import deque
 from contextlib import nullcontext
 from typing import Dict, List, Optional, Sequence
 
+from .fleet import current_round_id, next_round_id
+
 logger = logging.getLogger("consensus_overlord_tpu.prof")
 
 __all__ = ["DeviceProfiler", "ProfileSession", "StagedCall", "annotate"]
@@ -105,16 +107,27 @@ class StagedCall:
     stages are strictly sequential in time, so plain attribute writes
     are safe) and calls `finish()` once the result is in hand."""
 
-    __slots__ = ("_prof", "op", "batch", "padded", "ts", "stages", "_done")
+    __slots__ = ("_prof", "op", "batch", "padded", "ts", "stages",
+                 "stages_at_s", "round_id", "_done")
 
     def __init__(self, prof: "DeviceProfiler", op: str, batch: int,
-                 padded: Optional[int] = None):
+                 padded: Optional[int] = None,
+                 round_id: Optional[int] = None):
         self._prof = prof
         self.op = op
         self.batch = int(batch)
         self.padded = int(padded) if padded else None
         self.ts = time.time()
         self.stages: Dict[str, float] = {}
+        #: Offset (seconds since `ts`) at which each stage COMPLETED —
+        #: with `stages` (durations) this is enough to reconstruct the
+        #: round waterfall (start = at - duration) without putting a
+        #: second clock read on every boundary.
+        self.stages_at_s: Dict[str, float] = {}
+        #: The frontier flush this call serves (obs/fleet.py tag_round,
+        #: read off the dispatcher thread); freshly drawn when untagged
+        #: so ad-hoc/sim calls are still one-call-one-round.
+        self.round_id = round_id
         self._done = False
 
     def observe(self, stage: str, seconds: float) -> None:
@@ -123,6 +136,7 @@ class StagedCall:
         sub-batch)."""
         try:
             self.stages[stage] = self.stages.get(stage, 0.0) + seconds
+            self.stages_at_s[stage] = time.time() - self.ts
             self._prof.observe_stage(self.op, stage, seconds)
         except Exception:  # noqa: BLE001 — profiling never breaks crypto
             pass
@@ -199,12 +213,30 @@ class DeviceProfiler:
         self._last_occupancy: Optional[float] = None
         self._devices: List[str] = []
         self._device_latency: Dict[str, float] = {}
+        #: {(device, stage): [count, total_s, last_s]} — the per-device
+        #: attribution summary (obs/fleet.py's raw feed).
+        self._device_stages: Dict[tuple, List[float]] = {}
+        #: Last observed mesh-probe split {phase: seconds} — the
+        #: /statusz "profile" surface for the sharded_* histograms.
+        self._sharded: Dict[str, float] = {}
+        #: Optional StragglerDetector fed by device_stage().
+        self.straggler = None
 
     # -- staged calls ------------------------------------------------------
 
     def begin(self, op: str, batch: int,
               padded: Optional[int] = None) -> StagedCall:
-        return StagedCall(self, op, batch, padded)
+        # Tagged by the frontier's dispatcher (tag_round); a fresh id
+        # otherwise, so every stage-ring record carries one.
+        round_id = current_round_id()
+        if round_id is None:
+            round_id = next_round_id()
+        return StagedCall(self, op, batch, padded, round_id=round_id)
+
+    def attach_straggler(self, detector) -> None:
+        """Feed every device_stage observation through a
+        fleet.StragglerDetector (service/sim wiring)."""
+        self.straggler = detector
 
     def observe_stage(self, op: str, stage: str, seconds: float) -> None:
         with self._lock:
@@ -233,6 +265,11 @@ class DeviceProfiler:
                   "batch": call.batch, "ok": bool(ok),
                   "stages_s": {k: round(v, 6)
                                for k, v in call.stages.items()}}
+        if call.round_id is not None:
+            record["round_id"] = call.round_id
+        if call.stages_at_s:
+            record["stages_at_s"] = {k: round(v, 6)
+                                     for k, v in call.stages_at_s.items()}
         if call.padded:
             record["padded"] = call.padded
             record["occupancy"] = round(call.batch / call.padded, 4)
@@ -279,6 +316,32 @@ class DeviceProfiler:
             self.metrics.device_last_dispatch_seconds.labels(
                 device=str(device)).set(seconds)
 
+    def device_stage(self, device: str, stage: str, seconds: float,
+                     round_id: Optional[int] = None) -> None:
+        """Per-device timing of one mesh-dispatch stage — the
+        shard-fetch machinery generalized beyond readback (stage is
+        'readback' on the hot path, 'partial_reduce' /
+        'pairing_partial' from the sharded probe).  Lands in
+        `sharded_device_stage_seconds{device,stage}`, the per-device
+        summary, and the attached StragglerDetector."""
+        device = str(device)
+        with self._lock:
+            tot = self._device_stages.setdefault((device, stage),
+                                                 [0, 0.0, 0.0])
+            tot[0] += 1
+            tot[1] += seconds
+            tot[2] = seconds
+        if self.metrics is not None:
+            self.metrics.sharded_device_stage_seconds.labels(
+                device=device, stage=stage).observe(seconds)
+        if stage == "readback":
+            # Keep the r05 gauge in lockstep — readback IS the
+            # shard-fetch latency it always reported.
+            self.device_latency(device, seconds)
+        if self.straggler is not None:
+            self.straggler.observe(device, stage, seconds,
+                                   round_id=round_id)
+
     def sharded(self, phase: str, seconds: float) -> None:
         """One mesh-probe observation: phase is 'partial_reduce' (the
         per-device local validate+MSM work), 'allgather' (the ICI
@@ -286,6 +349,10 @@ class DeviceProfiler:
         'pairing_partial' (per-device Miller loops + local Fq12 tree),
         or 'pairing_combine' (all-gather of the D Fq12 partials +
         replicated combine tree)."""
+        # Keep the last split locally too: /statusz "profile" must
+        # surface the pairing partial/combine numbers even though they
+        # only live in histograms on the metrics side (the r14 gap).
+        self._sharded[phase] = seconds
         if self.metrics is None:
             return
         if phase == "partial_reduce":
@@ -315,9 +382,19 @@ class DeviceProfiler:
                     for op, stages in self._totals.items()
                     for stage, (c, s) in stages.items()}
 
+    def device_stage_totals(self) -> Dict[str, dict]:
+        """Per-device stage attribution, {device/stage: {count, total_s,
+        last_s}} — the JSON form of sharded_device_stage_seconds."""
+        with self._lock:
+            return {f"{dev}/{stage}": {"count": int(c),
+                                       "total_s": round(t, 6),
+                                       "last_s": round(last, 6)}
+                    for (dev, stage), (c, t, last)
+                    in self._device_stages.items()}
+
     def summary(self) -> dict:
         """The "profile" block sim/run.py / bench_round.py embed."""
-        return {
+        doc = {
             "crypto_device_stage_seconds": self.stage_totals(),
             "occupancy": self._last_occupancy,
             "devices": self._devices,
@@ -325,6 +402,15 @@ class DeviceProfiler:
                                        in self._device_latency.items()},
             "calls": len(self._ring),
         }
+        # Last mesh-probe split incl. the pairing partial/combine pair
+        # (previously histogram-only — the /statusz "profile" gap).
+        if self._sharded:
+            doc["sharded"] = {k: round(v, 6)
+                              for k, v in self._sharded.items()}
+        device_stages = self.device_stage_totals()
+        if device_stages:
+            doc["device_stages"] = device_stages
+        return doc
 
     def statusz(self, tail: int = 32) -> dict:
         """The /statusz "profile" section: summary + the recent ring."""

@@ -136,6 +136,45 @@ class TelemetrySampler:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: Per-sample observers (obs/anomaly.py AnomalyDetector): each
+        #: gets every sample doc, after it entered the ring.
+        self._observers: List[Callable[[dict], None]] = []
+        #: Baseline for per-sample stage means (cumulative stage totals
+        #: at the previous sample).
+        self._last_stage_totals: Dict[str, tuple] = {}
+        self._rotate_existing()
+
+    def _rotate_existing(self) -> None:
+        """Enforce the JSONL size bound against a PRE-EXISTING file
+        (e.g. left by a crashed soak): count its lines into `_written`
+        so the append-time bound applies from sample one, and rewrite
+        immediately when it already exceeds the bound — keeping the
+        newest `window` lines, the same retention the ring gives."""
+        if self.out_path is None:
+            return
+        try:
+            if not os.path.exists(self.out_path):
+                return
+            with open(self.out_path) as f:
+                lines = f.readlines()
+            if len(lines) >= self.max_file_samples:
+                keep = lines[-self._ring.maxlen:]
+                with open(self.out_path, "w") as f:
+                    f.writelines(keep)
+                with self._lock:
+                    self._written = len(keep)
+            else:
+                with self._lock:
+                    self._written = len(lines)
+        except Exception:  # noqa: BLE001 — a sick file must not kill boot
+            pass
+
+    def add_observer(self, fn: Callable[[dict], None]
+                     ) -> "TelemetrySampler":
+        """Register a per-sample observer (called synchronously on the
+        sampler thread with each sample doc)."""
+        self._observers.append(fn)
+        return self
 
     # -- collection --------------------------------------------------------
 
@@ -217,9 +256,18 @@ class TelemetrySampler:
         occ = self._occupancy()
         if occ is not None:
             doc["occupancy"] = round(occ, 4)
+        stage_means = self._stage_means()
+        if stage_means:
+            doc["stage_means_s"] = stage_means
         counters = self._counters()
         if counters:
             doc["counters"] = counters
+        if self.out_path is not None:
+            try:
+                doc["telemetry_jsonl_bytes"] = os.path.getsize(
+                    self.out_path)
+            except Exception:  # noqa: BLE001 — no file yet
+                pass
         if self._extra_fn is not None:
             try:
                 doc.update(self._extra_fn() or {})
@@ -228,7 +276,33 @@ class TelemetrySampler:
         with self._lock:
             self._ring.append(doc)
         self._write(doc)
+        for observer in self._observers:
+            try:
+                observer(doc)
+            except Exception:  # noqa: BLE001 — observers are best-effort
+                pass
         return doc
+
+    def _stage_means(self) -> Dict[str, float]:
+        """Mean seconds per stage over the calls since the LAST sample
+        (differencing the profiler's cumulative totals) — the series
+        the anomaly layer's stage_time_spike detector watches."""
+        if self._profiler is None:
+            return {}
+        try:
+            totals = self._profiler.stage_totals()
+        except Exception:  # noqa: BLE001
+            return {}
+        out: Dict[str, float] = {}
+        for key, tot in totals.items():
+            count, total_s = tot["count"], tot["total_s"]
+            last_count, last_total = self._last_stage_totals.get(
+                key, (0, 0.0))
+            if count > last_count:
+                out[key] = round(
+                    (total_s - last_total) / (count - last_count), 6)
+            self._last_stage_totals[key] = (count, total_s)
+        return out
 
     def _write(self, doc: dict) -> None:
         if self.out_path is None:
@@ -338,6 +412,10 @@ class TelemetrySampler:
         cache = (last.get("compile_cache") or {})
         if cache.get("hit_ratio") is not None:
             doc["compile_cache_hit_ratio"] = cache["hit_ratio"]
+        # JSONL sink size: the bound-enforcement surface (rotation
+        # keeps this sawtoothing below max_file_samples lines).
+        if last.get("telemetry_jsonl_bytes") is not None:
+            doc["telemetry_jsonl_bytes"] = last["telemetry_jsonl_bytes"]
         churn = doc.get("flightrec_recorded_delta")
         if churn is not None:
             doc["flightrec_events_per_s"] = round(churn / span, 3)

@@ -133,6 +133,19 @@ class ConsensusConfig:
     #: tail, which is reconnaissance material on a routable host.
     #: /metrics stays reachable either way (fleet Prometheus scrapes).
     statusz_public: bool = False
+    #: Straggler detection (obs/fleet.py StragglerDetector): flag a
+    #: device whose rolling-median stage time exceeds the mesh median
+    #: by this ratio; served under /statusz "mesh".  <= 0 disables the
+    #: detector entirely.
+    straggler_ratio: float = 1.5
+    #: Cross-host telemetry aggregation (obs/fleet.py FleetAggregator):
+    #: peer metrics endpoints ("host:port") whose /statusz trend blocks
+    #: host 0 merges into the /statusz "fleet" section.  Empty = the
+    #: single-process degenerate mode (the section still renders, over
+    #: this host's trend alone).
+    fleet_peers: tuple = ()
+    #: This host's row label in the "fleet" section.
+    fleet_host_name: str = "local"
     #: gRPC method-path namespace: "native" serves/dials
     #: consensus_overlord_tpu.* paths; "cita_cloud" uses the reference
     #: mesh's cita_cloud_proto package names (src/main.rs:64-73) so this
@@ -182,6 +195,11 @@ class ConsensusConfig:
                 f"mesh must be off|local|global, got {self.mesh!r} (a "
                 "typo here would silently fall back to the single-chip "
                 "kernel set)")
+        if 0 < self.straggler_ratio < 1:
+            raise ValueError(
+                f"straggler_ratio must be >= 1 (or <= 0 to disable), "
+                f"got {self.straggler_ratio} — a sub-1 ratio flags "
+                "every device below the median")
 
     @property
     def device_pairing_flag(self) -> Optional[bool]:

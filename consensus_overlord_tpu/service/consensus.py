@@ -123,7 +123,8 @@ class Consensus:
             linger_s=config.frontier_linger_ms / 1000.0, metrics=metrics,
             max_pending=config.effective_tenant_queue_bound,
             weight=config.tenant_weight,
-            priority_lanes=config.tenant_priority_lanes)
+            priority_lanes=config.tenant_priority_lanes,
+            recorder=recorder)
         bind = getattr(self.crypto, "bind_metrics", None)
         if bind is not None and metrics is not None:
             bind(metrics)

@@ -70,6 +70,9 @@ class ServiceRuntime:
                        if lc is not None and lc.agent_endpoint else None)
         self.consensus: Optional[Consensus] = None
         self.sampler = None
+        self.straggler = None
+        self.anomaly = None
+        self.fleet = None
         self.health: Optional[HealthServer] = None
         self.bound_port: Optional[int] = None
         self.metrics_port: Optional[int] = None
@@ -136,6 +139,26 @@ class ServiceRuntime:
                 self.metrics.add_debug_handler(
                     "/debug/profile",
                     lambda q: session.request(int(q.get("rounds", "1"))))
+            # Fleet observability (obs/fleet.py + obs/anomaly.py):
+            # straggler detection over the per-device stage samples,
+            # anomaly alerting over the telemetry series, and the
+            # cross-host trend merge — the /statusz "mesh" / "alerts" /
+            # "fleet" sections.
+            if profiler is not None and cfg.straggler_ratio > 0:
+                from ..obs import StragglerDetector
+
+                self.straggler = StragglerDetector(
+                    metrics=self.metrics, recorder=self.recorder,
+                    ratio=cfg.straggler_ratio)
+                profiler.attach_straggler(self.straggler)
+                self.metrics.add_status_source(
+                    "mesh", self.straggler.statusz)
+            from ..obs import AnomalyDetector
+
+            self.anomaly = AnomalyDetector(
+                metrics=self.metrics, recorder=self.recorder,
+                straggler=self.straggler)
+            self.metrics.add_status_source("alerts", self.anomaly.statusz)
         # Soak telemetry: periodic drift snapshots (WAL size, ring
         # churn, RSS, compile-cache ratio, breaker state) into a
         # bounded window; /statusz "trend" serves the deltas so an
@@ -158,9 +181,21 @@ class ServiceRuntime:
                 recorders_fn=lambda: ([recorder] if recorder else []),
                 breaker_status_fn=getattr(self.consensus.crypto,
                                           "degraded_status", None),
-                profiler=self.consensus.profiler).start()
+                profiler=self.consensus.profiler)
+            if self.anomaly is not None:
+                self.sampler.add_observer(self.anomaly.observe_sample)
+            self.sampler.start()
             if self.metrics is not None:
                 self.metrics.add_status_source("trend", self.sampler.trend)
+                # Cross-host aggregation: this host's trend + every
+                # configured peer's, merged into the "fleet" section
+                # (peers empty = the single-process degenerate mode).
+                from ..obs import FleetAggregator
+
+                self.fleet = FleetAggregator(
+                    cfg.fleet_host_name, self.sampler.trend,
+                    peers=cfg.fleet_peers)
+                self.metrics.add_status_source("fleet", self.fleet.statusz)
         interceptors = [TraceContextInterceptor(exporter=self.tracer)]
         if self.metrics is not None:
             interceptors.append(self.metrics.interceptor())

@@ -97,7 +97,8 @@ class SimNode:
         else:
             self.frontier = (BatchingVerifier(crypto, frontier_max_batch,
                                               frontier_linger_s,
-                                              metrics=metrics)
+                                              metrics=metrics,
+                                              recorder=recorder)
                              if use_frontier else None)
         self.recorder = recorder
         if metrics is not None:

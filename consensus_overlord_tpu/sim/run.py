@@ -346,6 +346,22 @@ def main() -> None:
                         help="drift gate: min compile-cache hit ratio "
                         "at soak end (0 disables; CPU sims may never "
                         "touch the cache)")
+    parser.add_argument("--soak-max-alerts", type=int, default=None,
+                        help="alert gate: fail the soak (exit 3, like a "
+                        "drift breach) when the anomaly layer "
+                        "(obs/anomaly.py) raised more than this many "
+                        "alerts by soak end (omit to disable; 0 = any "
+                        "alert fails)")
+    parser.add_argument("--soak-inject-alerts", type=int, default=0,
+                        help="raise this many synthetic alerts before "
+                        "the gate runs — the alert-storm fixture the "
+                        "nightly lane uses to prove --soak-max-alerts "
+                        "actually gates")
+    parser.add_argument("--straggler-ratio", type=float, default=1.5,
+                        help="straggler detection threshold: flag a "
+                        "device whose rolling-median stage time exceeds "
+                        "the mesh median by this ratio (obs/fleet.py; "
+                        "<= 0 disables the detector)")
     parser.add_argument("--flightrec", type=int, default=256,
                         help="per-node flight-recorder capacity (events); "
                         "rings are dumped if the run times out.  0 = off")
@@ -459,8 +475,10 @@ def main() -> None:
     async def run() -> dict:
         import tempfile
 
-        from ..obs import (DeviceProfiler, Metrics, ProfileSession,
-                           TelemetrySampler, drift_check, snapshot)
+        from ..obs import (AnomalyDetector, DeviceProfiler,
+                           FleetAggregator, Metrics, ProfileSession,
+                           StragglerDetector, TelemetrySampler,
+                           drift_check, snapshot)
         from ..obs.telemetry import wal_size_bytes
 
         metrics = Metrics()
@@ -545,6 +563,25 @@ def main() -> None:
             breaker_status_fn=getattr(net.nodes[0].crypto,
                                       "degraded_status", None),
             profiler=profiler)
+        # Fleet observability (obs/fleet.py + obs/anomaly.py): the
+        # straggler detector rides the profiler's per-device stage
+        # samples, the anomaly detector rides every telemetry sample,
+        # and the fleet aggregator merges this process's trend in
+        # single-process degenerate mode (CPU CI's merge-path coverage).
+        # Node 0's recorder survives chaos crash-restarts (the harness
+        # carries it across), so straggler/alert events stay findable.
+        event_recorder = net.nodes[0].recorder
+        straggler = None
+        if args.straggler_ratio > 0:
+            straggler = StragglerDetector(metrics=metrics,
+                                          recorder=event_recorder,
+                                          ratio=args.straggler_ratio)
+            profiler.attach_straggler(straggler)
+        anomaly = AnomalyDetector(metrics=metrics,
+                                  recorder=event_recorder,
+                                  straggler=straggler)
+        sampler.add_observer(anomaly.observe_sample)
+        fleet = FleetAggregator("sim", sampler.trend)
         statusz_port = None
         if args.statusz_port is not None:
             # The fleet shares one registry; statusz reports node 0's
@@ -568,6 +605,13 @@ def main() -> None:
             # Drift over the retained sample window — the live answer
             # to "is anything creeping" without reading the JSONL.
             metrics.add_status_source("trend", sampler.trend)
+            # Fleet observability sections: per-device straggler state,
+            # the anomaly-alert ring, and the (degenerate, single-
+            # process) cross-host trend merge.
+            if straggler is not None:
+                metrics.add_status_source("mesh", straggler.statusz)
+            metrics.add_status_source("alerts", anomaly.statusz)
+            metrics.add_status_source("fleet", fleet.statusz)
             metrics.add_debug_handler(
                 "/debug/profile",
                 lambda q: session.request(int(q.get("rounds", "1"))))
@@ -849,7 +893,13 @@ def main() -> None:
                           "out_path": soak_out,
                           "soak_seconds": args.soak_seconds,
                           "trend": sampler.trend()},
+            # Fleet observability disposition: the anomaly-alert tally
+            # (summary-side twin of /statusz "alerts") and, when the
+            # straggler detector ran, its per-device medians ("mesh").
+            "alerts": anomaly.statusz(8),
         }
+        if straggler is not None:
+            out["mesh"] = straggler.statusz()
         if chaos is not None:
             out["chaos"] = {
                 "seed": chaos_seed,
@@ -890,6 +940,18 @@ def main() -> None:
                 "min_compile_cache_hit_ratio": args.soak_min_cache_ratio,
             }
             drift_failures = drift_check(trend, thresholds)
+            # Synthetic alert storm: the CI fixture for the alert gate —
+            # raised through the real raise_alert path so the counter,
+            # flightrec event, and /statusz section all light up.
+            for i in range(args.soak_inject_alerts):
+                anomaly.raise_alert("synthetic_storm", index=i)
+            if args.soak_max_alerts is not None and \
+                    anomaly.alert_count() > args.soak_max_alerts:
+                # Alert-budget breaches ride the drift-failure verdict:
+                # same exit-3 lane, distinct message.
+                drift_failures.append(
+                    f"alerts: {anomaly.alert_count()} raised exceeds "
+                    f"--soak-max-alerts {args.soak_max_alerts}")
             breaker_cycles = scraped.get(
                 "crypto_breaker_transitions_total{to=closed}", 0)
             soak_dims = {k: v for k, v in {
@@ -917,6 +979,8 @@ def main() -> None:
                 "drift_failures": drift_failures,
                 "soak": soak_dims,
                 "record_path": args.soak_record,
+                "alerts": anomaly.alert_count(),
+                "max_alerts": args.soak_max_alerts,
             }
             # The survival BenchRecord: one ledger line per soak, so
             # `scripts/ledger.py trend` tracks commit rate and drift

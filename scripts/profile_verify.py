@@ -89,6 +89,16 @@ def main() -> int:
     ap.add_argument("--profile-dir", default=None,
                     help="capture an XLA trace of one measured batch "
                     "into this directory (ProfileSession)")
+    ap.add_argument("--inject-straggler", default=None, metavar="DEVICE",
+                    help="sleep inside DEVICE's shard-readback timing "
+                    "window (e.g. 'cpu:3') so the straggler detector "
+                    "has a seeded fault to flag — the CI fixture")
+    ap.add_argument("--inject-straggler-ms", type=float, default=50.0,
+                    help="injected per-shard delay in milliseconds "
+                    "(default 50)")
+    ap.add_argument("--straggler-ratio", type=float, default=1.5,
+                    help="straggler flag ratio vs the mesh median "
+                    "(<= 0 disables the detector)")
     args = ap.parse_args()
 
     if args.mesh:
@@ -112,8 +122,10 @@ def main() -> int:
     import jax
 
     from consensus_overlord_tpu.crypto import tpu_provider as tp
-    from consensus_overlord_tpu.obs import (DeviceProfiler, Metrics,
-                                            ProfileSession)
+    from consensus_overlord_tpu.obs import (AnomalyDetector, DeviceProfiler,
+                                            FlightRecorder, Metrics,
+                                            ProfileSession,
+                                            StragglerDetector)
 
     say = (lambda *a: None) if args.json else (
         lambda *a: print(*a, file=sys.stderr, flush=True))
@@ -144,6 +156,19 @@ def main() -> int:
     prof = DeviceProfiler(metrics)
     provider.bind_metrics(metrics)
     provider.bind_profiler(prof)
+    recorder = FlightRecorder(256)
+    straggler = None
+    if args.straggler_ratio > 0:
+        straggler = StragglerDetector(metrics=metrics, recorder=recorder,
+                                      ratio=args.straggler_ratio)
+        prof.attach_straggler(straggler)
+    anomaly = AnomalyDetector(metrics=metrics, recorder=recorder,
+                              straggler=straggler)
+    if args.inject_straggler:
+        provider.inject_straggler(args.inject_straggler,
+                                  args.inject_straggler_ms / 1e3)
+        say(f"straggler injection: {args.inject_straggler} "
+            f"+{args.inject_straggler_ms:.0f} ms/shard")
 
     session = ProfileSession(args.profile_dir)
     trace_dir = None
@@ -171,7 +196,12 @@ def main() -> int:
 
     sharded = None
     if args.sharded_probe:
-        sharded = provider.profile_sharded_stages(sigs, pks)
+        # The straggler detector needs a rolling median per device
+        # (min_samples per device/stage), so under injection the probe
+        # repeats until the seeded fault can actually flag.
+        probe_reps = 3 if args.inject_straggler else 1
+        for _ in range(probe_reps):
+            sharded = provider.profile_sharded_stages(sigs, pks)
         say(f"{'partial_red':12s} "
             f"{sharded['partial_reduce_s'] * 1e3:9.2f} ms  "
             f"({sharded['devices']} device(s))")
@@ -180,6 +210,13 @@ def main() -> int:
             f"{sharded['pairing_partial_s'] * 1e3:9.2f} ms")
         say(f"{'pair_combine':12s} "
             f"{sharded['pairing_combine_s'] * 1e3:9.2f} ms")
+        for key, row in sorted((sharded.get("device_stage_s")
+                                or {}).items()):
+            say(f"  {key:20s} {row['last_s'] * 1e3:9.3f} ms  "
+                f"(n={row['count']})")
+        if straggler is not None and straggler.flagged_devices():
+            say(f"stragglers flagged: "
+                f"{', '.join(straggler.flagged_devices())}")
 
     from consensus_overlord_tpu.obs import ledger
 
@@ -205,6 +242,14 @@ def main() -> int:
         "devices": summary["devices"],
         "sharded": sharded,
         "trace_dir": trace_dir,
+        # Fleet observability tail: per-device cumulative stage rows,
+        # the straggler detector's verdict, and the alert tally — what
+        # the nightly fleet-obs-smoke lane asserts on.
+        "device_stages": prof.device_stage_totals(),
+        "mesh": straggler.statusz() if straggler is not None else None,
+        "stragglers": (straggler.flagged_devices()
+                       if straggler is not None else []),
+        "alerts_total": anomaly.alert_count(),
     }, profiler=prof)), flush=True)
     return 0
 

@@ -1,0 +1,218 @@
+"""Round waterfalls: reconstruct per-round timelines from the fleet
+observability artifacts — the flight-recorder dump (round_flush /
+straggler / alert events) joined with the device-profile ring
+(stages_s + stages_at_s per staged call) on the shared `round_id` tag
+(consensus_overlord_tpu/obs/fleet.py).
+
+Each round renders as an ordered waterfall: queue-wait (from the
+round_flush event's queue_wait_s) then every profiled stage —
+parse → dispatch → readback → pairing on the single-chip path, plus
+the sharded partial/combine stages when the mesh path ran — with
+per-stage start offsets recovered from stages_at_s (completion offset)
+minus stages_s (duration).  Straggler and alert events tagged with the
+round ride along as annotations.
+
+Input files are auto-detected by shape:
+
+  * a sim/run.py or profile_verify.py JSON tail (``"profile": {"recent":
+    [...]}`` — the staged-call ring, plus optional ``"flightrec"``)
+  * a /statusz document (``"flightrec"`` event list + ``"profile"``)
+  * a bare JSON list of flight-recorder events or ring records
+
+Usage:
+  python scripts/waterfall.py summary.json [more.json ...] [--json]
+      [--rounds K] [--round ID]
+
+Text rendering goes to stdout; --json instead emits one structured
+document {"rounds": [...], "count": N} (the CI contract: nightly
+fleet-obs-smoke asserts >= 3 reconstructed rounds from a sim summary).
+Exit 0 with >= 1 round reconstructed, 4 when no round-tagged data was
+found (distinct from argparse's 2).
+"""
+
+import argparse
+import json
+import sys
+
+#: Render order fallback for stages that never got a stages_at_s
+#: completion offset (older ring records): the hot path's fixed order.
+_STAGE_RANK = {"parse": 0, "dispatch": 1, "partial_reduce": 2,
+               "allgather": 3, "readback": 4, "pairing_partial": 5,
+               "pairing_combine": 6, "pairing": 7, "final_exp": 8}
+
+
+def _load(path: str):
+    """One artifact file → (ring_records, events)."""
+    with open(path) as f:
+        doc = json.load(f)
+    rings, events = [], []
+    if isinstance(doc, list):
+        for entry in doc:
+            if not isinstance(entry, dict):
+                continue
+            if "kind" in entry:
+                events.append(entry)
+            elif "stages_s" in entry:
+                rings.append(entry)
+        return rings, events
+    if not isinstance(doc, dict):
+        return rings, events
+    profile = doc.get("profile")
+    if isinstance(profile, dict):
+        rings.extend(r for r in profile.get("recent", [])
+                     if isinstance(r, dict))
+    flightrec = doc.get("flightrec")
+    if isinstance(flightrec, list):
+        events.extend(e for e in flightrec if isinstance(e, dict))
+    # statusz nests the ring under profile.recent too; a bare
+    # profile-shaped dict (stage ring at top level) also works.
+    if not rings and isinstance(doc.get("recent"), list):
+        rings.extend(r for r in doc["recent"] if isinstance(r, dict))
+    return rings, events
+
+
+def _segments(record: dict):
+    """One staged-call ring record → [(start_offset_s, dur_s, stage)].
+
+    stages_at_s holds each stage's COMPLETION offset from the call's
+    start; subtracting the stage duration recovers its start, so the
+    waterfall shows real overlap/gaps instead of assuming stages abut.
+    """
+    stages = record.get("stages_s") or {}
+    at = record.get("stages_at_s") or {}
+    segs = []
+    cursor = 0.0
+    for rank, stage in enumerate(sorted(
+            stages, key=lambda s: (at[s] if s in at
+                                   else _STAGE_RANK.get(s, 99)))):
+        dur = float(stages[stage])
+        if stage in at:
+            start = max(float(at[stage]) - dur, 0.0)
+        else:  # legacy record: assume stages abut in rank order
+            start = cursor
+        cursor = start + dur
+        segs.append((start, dur, stage))
+    return segs
+
+
+def build_rounds(rings, events):
+    """Join ring records + events on round_id → ordered round list."""
+    rounds = {}
+
+    def slot(rid):
+        return rounds.setdefault(rid, {
+            "round_id": rid, "segments": [], "annotations": [],
+            "batch": None, "queue_wait_s": None, "ops": []})
+
+    for e in events:
+        rid = e.get("round_id")
+        if rid is None:
+            continue
+        r = slot(rid)
+        if e.get("kind") == "round_flush":
+            r["batch"] = e.get("batch")
+            qw = e.get("queue_wait_s")
+            if qw:
+                r["queue_wait_s"] = float(qw)
+                # Queue wait precedes every profiled stage: negative
+                # offsets keep stage starts anchored at flush time 0.
+                r["segments"].append(
+                    {"stage": "queue_wait", "start_s": -float(qw),
+                     "dur_s": float(qw)})
+        else:
+            r["annotations"].append(
+                {k: v for k, v in e.items() if k not in ("seq",)})
+    for rec in rings:
+        rid = rec.get("round_id")
+        if rid is None:
+            continue
+        r = slot(rid)
+        r["ops"].append(rec.get("op"))
+        if rec.get("batch") and r["batch"] is None:
+            r["batch"] = rec["batch"]
+        for start, dur, stage in _segments(rec):
+            r["segments"].append(
+                {"stage": stage, "start_s": round(start, 6),
+                 "dur_s": round(dur, 6)})
+    out = []
+    for rid in sorted(rounds):
+        r = rounds[rid]
+        r["segments"].sort(key=lambda s: (s["start_s"], s["stage"]))
+        if r["segments"]:
+            last = max(s["start_s"] + s["dur_s"] for s in r["segments"])
+            first = min(s["start_s"] for s in r["segments"])
+            r["span_s"] = round(last - first, 6)
+        out.append(r)
+    return out
+
+
+def render_text(rounds, width: int = 44) -> str:
+    lines = []
+    for r in rounds:
+        ops = ",".join(sorted({o for o in r["ops"] if o})) or "-"
+        head = (f"round {r['round_id']}  batch={r['batch'] or '-'}  "
+                f"op={ops}  span={r.get('span_s', 0) * 1e3:.2f} ms")
+        lines.append(head)
+        segs = r["segments"]
+        if not segs:
+            lines.append("  (no stage data)")
+            continue
+        t0 = min(s["start_s"] for s in segs)
+        t1 = max(s["start_s"] + s["dur_s"] for s in segs)
+        span = max(t1 - t0, 1e-9)
+        for s in segs:
+            lead = int((s["start_s"] - t0) / span * width)
+            bar = max(int(s["dur_s"] / span * width), 1)
+            lines.append(f"  {s['stage']:>16s} "
+                         f"{(s['start_s']) * 1e3:+9.3f} ms "
+                         f"{s['dur_s'] * 1e3:9.3f} ms  "
+                         f"{' ' * lead}{'#' * bar}")
+        for a in r["annotations"]:
+            kind = a.get("kind", "?")
+            extras = " ".join(f"{k}={a[k]}" for k in a
+                              if k not in ("kind", "ts", "round_id"))
+            lines.append(f"  !{kind:>15s} {extras}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="reconstruct per-round stage waterfalls from "
+                    "flightrec + profile-ring artifacts")
+    ap.add_argument("files", nargs="+",
+                    help="JSON artifacts (sim summary, statusz doc, or "
+                    "bare event/ring lists)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the structured timeline document instead "
+                    "of the text rendering")
+    ap.add_argument("--rounds", type=int, default=None, metavar="K",
+                    help="render only the last K rounds")
+    ap.add_argument("--round", type=int, default=None, metavar="ID",
+                    help="render only this round_id")
+    args = ap.parse_args()
+
+    rings, events = [], []
+    for path in args.files:
+        r, e = _load(path)
+        rings.extend(r)
+        events.extend(e)
+    rounds = build_rounds(rings, events)
+    if args.round is not None:
+        rounds = [r for r in rounds if r["round_id"] == args.round]
+    if args.rounds is not None:
+        rounds = rounds[-args.rounds:]
+    if args.json:
+        print(json.dumps({"rounds": rounds, "count": len(rounds)}))
+    else:
+        print(render_text(rounds))
+        print(f"rounds: {len(rounds)}  ring_records: {len(rings)}  "
+              f"events: {len(events)}")
+    if not rounds:
+        print("no round-tagged data found", file=sys.stderr)
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

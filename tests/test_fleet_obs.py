@@ -1,0 +1,606 @@
+"""Fleet observability layer (obs/fleet.py + obs/anomaly.py): round-id
+tagging through the frontier, the straggler detector (unit + the
+8-lane virtual CPU mesh with an injected per-device sleep), the
+cross-host fleet aggregator (degenerate mode + a real loopback peer
+pull), telemetry startup rotation + the per-sample observer hook, the
+EWMA anomaly detectors, and scripts/waterfall.py's round
+reconstruction."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import unittest
+import urllib.request
+
+import numpy as np
+
+from consensus_overlord_tpu.core.sm3 import sm3_hash
+from consensus_overlord_tpu.crypto.frontier import BatchingVerifier
+from consensus_overlord_tpu.crypto.provider import CpuBlsCrypto
+from consensus_overlord_tpu.obs import (AnomalyDetector, DeviceProfiler,
+                                        FleetAggregator, FlightRecorder,
+                                        Metrics, StragglerDetector,
+                                        TelemetrySampler, snapshot)
+from consensus_overlord_tpu.obs.anomaly import EwmaSeries
+from consensus_overlord_tpu.obs.fleet import (current_round_id,
+                                              next_round_id, tag_round)
+
+WATERFALL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "scripts", "waterfall.py")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# round tagging
+# ---------------------------------------------------------------------------
+
+class RoundTagging(unittest.TestCase):
+    def test_ids_monotonic(self):
+        a, b = next_round_id(), next_round_id()
+        self.assertGreater(b, a)
+
+    def test_tag_round_sets_and_restores(self):
+        self.assertIsNone(current_round_id())
+        with tag_round(7):
+            self.assertEqual(current_round_id(), 7)
+            with tag_round(8):  # nests
+                self.assertEqual(current_round_id(), 8)
+            self.assertEqual(current_round_id(), 7)
+        self.assertIsNone(current_round_id())
+
+    def test_tag_is_thread_local(self):
+        import threading
+
+        seen = []
+        with tag_round(42):
+            t = threading.Thread(
+                target=lambda: seen.append(current_round_id()))
+            t.start()
+            t.join()
+        self.assertEqual(seen, [None])
+
+
+class TaggedCrypto(CpuBlsCrypto):
+    """Captures the round id visible INSIDE verify_batch — i.e. on the
+    frontier's dispatch thread, where the provider's profiler hooks
+    run."""
+
+    def __init__(self, sk):
+        super().__init__(sk)
+        self.seen_round_ids = []
+
+    def verify_batch(self, sigs, hashes, voters):
+        self.seen_round_ids.append(current_round_id())
+        return super().verify_batch(sigs, hashes, voters)
+
+
+class FrontierRoundFlush(unittest.TestCase):
+    def test_flush_records_round_and_tags_dispatch(self):
+        """Each frontier flush draws a round id, records a round_flush
+        flightrec event carrying it, and the provider's verify runs
+        inside a tag_round scope with the same id."""
+        async def main():
+            crypto = TaggedCrypto(0xC0FFEE)
+            rec = FlightRecorder(64)
+            fr = BatchingVerifier(crypto, max_batch=64, linger_s=0.005,
+                                  recorder=rec)
+            h = sm3_hash(b"payload")
+            sig = crypto.sign(h)
+            ok = await fr.verify(sig, h, crypto.pub_key,
+                                 msg_type="SignedVote")
+            fr.close()
+            return ok, rec.tail(), crypto.seen_round_ids
+
+        ok, events, seen = run(main())
+        self.assertTrue(ok)
+        flushes = [e for e in events if e["kind"] == "round_flush"]
+        self.assertEqual(len(flushes), 1)
+        flush = flushes[0]
+        self.assertEqual(flush["batch"], 1)
+        self.assertGreaterEqual(flush["queue_wait_s"], 0.0)
+        # the provider saw the SAME id the flush event carries
+        self.assertEqual(seen, [flush["round_id"]])
+
+    def test_successive_flushes_get_increasing_ids(self):
+        async def main():
+            crypto = TaggedCrypto(0xBEEF)
+            rec = FlightRecorder(64)
+            fr = BatchingVerifier(crypto, max_batch=1, linger_s=0.001,
+                                  recorder=rec)
+            h = sm3_hash(b"p")
+            sig = crypto.sign(h)
+            for _ in range(3):
+                await fr.verify(sig, h, crypto.pub_key,
+                                msg_type="SignedVote")
+            fr.close()
+            return [e["round_id"] for e in rec.tail()
+                    if e["kind"] == "round_flush"]
+
+        ids = run(main())
+        self.assertEqual(len(ids), 3)
+        self.assertEqual(ids, sorted(ids))
+        self.assertEqual(len(set(ids)), 3)
+
+
+# ---------------------------------------------------------------------------
+# straggler detector (unit)
+# ---------------------------------------------------------------------------
+
+class StragglerUnit(unittest.TestCase):
+    def test_flags_outlier_device(self):
+        m = Metrics()
+        rec = FlightRecorder(32)
+        det = StragglerDetector(metrics=m, recorder=rec, ratio=1.5,
+                                min_samples=3)
+        flagged = []
+        for _ in range(3):
+            for dev in ("cpu:0", "cpu:1", "cpu:2"):
+                det.observe(dev, "readback", 0.001)
+            flagged.append(det.observe("cpu:3", "readback", 0.010))
+        self.assertTrue(flagged[-1])  # enough history by the 3rd round
+        self.assertEqual(det.flagged_devices(), ["cpu:3"])
+        self.assertGreaterEqual(det.flag_count("cpu:3"), 1)
+        self.assertEqual(det.flag_count("cpu:0"), 0)
+        s = snapshot(m.registry)
+        key = "mesh_straggler_total{device=cpu:3,stage=readback}"
+        self.assertGreaterEqual(s[key], 1)
+        events = [e for e in rec.tail() if e["kind"] == "straggler"]
+        self.assertTrue(events)
+        self.assertEqual(events[-1]["device"], "cpu:3")
+        self.assertGreater(events[-1]["skew"], 1.5)
+
+    def test_needs_min_samples_and_two_devices(self):
+        det = StragglerDetector(min_samples=3)
+        # one device alone can never be a straggler
+        for _ in range(10):
+            self.assertFalse(det.observe("cpu:0", "readback", 0.01))
+        # a second device below min_samples doesn't flag either
+        self.assertFalse(det.observe("cpu:1", "readback", 1.0))
+        self.assertEqual(det.flagged_devices(), [])
+
+    def test_statusz_shape(self):
+        det = StragglerDetector(ratio=2.0, min_samples=2)
+        for _ in range(2):
+            det.observe("cpu:0", "readback", 0.001)
+            det.observe("cpu:1", "readback", 0.009)
+        doc = det.statusz()
+        self.assertEqual(doc["ratio"], 2.0)
+        devs = doc["stages"]["readback"]["devices"]
+        self.assertEqual(set(devs), {"cpu:0", "cpu:1"})
+        self.assertEqual(devs["cpu:1"]["samples"], 2)
+        self.assertIsNotNone(doc["stages"]["readback"]["mesh_median_s"])
+        self.assertIn("flags", doc)
+        self.assertIn("flagged_devices", doc)
+        self.assertTrue(json.dumps(doc))  # JSON-encodable
+
+    def test_observe_never_raises(self):
+        det = StragglerDetector()
+        self.assertFalse(det.observe(object(), None, "nan"))
+
+
+# ---------------------------------------------------------------------------
+# straggler detection on the 8-lane virtual CPU mesh
+# ---------------------------------------------------------------------------
+
+class StragglerOnVirtualMesh(unittest.TestCase):
+    def test_injected_sleep_flags_exactly_that_device(self):
+        """The production injection path end to end: a sharded array's
+        per-shard fetches (TpuBlsCrypto._shard_latencies) feed
+        DeviceProfiler.device_stage, the injected sleep sits inside
+        cpu:3's timed window, and the detector flags exactly cpu:3 —
+        counter, flightrec event, and /statusz "mesh" all agree."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+        from consensus_overlord_tpu.parallel import make_mesh
+
+        if len(jax.devices()) < 8:  # pragma: no cover — conftest forces 8
+            self.skipTest("needs the 8-device virtual mesh")
+        m = Metrics()
+        rec = FlightRecorder(64)
+        prof = DeviceProfiler(m)
+        det = StragglerDetector(metrics=m, recorder=rec, ratio=1.5,
+                                min_samples=3)
+        prof.attach_straggler(det)
+        provider = TpuBlsCrypto(0xA11CE)
+        provider.bind_profiler(prof)
+        # 50 ms: wide enough that background load on a busy CI host
+        # can't drag the healthy lanes' fetches over ratio*median
+        provider.inject_straggler("cpu:3", 0.05)
+
+        mesh = make_mesh(8)
+        arr = jax.device_put(
+            np.arange(8, dtype=np.int32),
+            NamedSharding(mesh, PartitionSpec("lanes")))
+        with tag_round(99):
+            for _ in range(3):
+                provider._shard_latencies(arr, sampled=True,
+                                          stage="readback")
+
+        self.assertEqual(det.flagged_devices(), ["cpu:3"])
+        s = snapshot(m.registry)
+        key = "mesh_straggler_total{device=cpu:3,stage=readback}"
+        self.assertGreaterEqual(s[key], 1)
+        # all 8 lanes got per-device stage rows
+        rows = prof.device_stage_totals()
+        devs = {k.split("/", 1)[0] for k in rows}
+        self.assertEqual(devs, {f"cpu:{i}" for i in range(8)})
+        events = [e for e in rec.tail() if e["kind"] == "straggler"]
+        self.assertTrue(events)
+        self.assertEqual(events[-1]["device"], "cpu:3")
+        self.assertEqual(events[-1]["round_id"], 99)
+        mesh_doc = det.statusz()
+        self.assertEqual(mesh_doc["flagged_devices"], ["cpu:3"])
+        self.assertGreater(
+            mesh_doc["stages"]["readback"]["devices"]["cpu:3"]["skew"],
+            1.5)
+        # clearing the injection stops the sleep (seconds <= 0 clears)
+        provider.inject_straggler("cpu:3", 0)
+        self.assertEqual(provider._inject_straggler, {})
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregator
+# ---------------------------------------------------------------------------
+
+TREND_DOC = {
+    "samples": 5, "span_s": 10.0, "rss_delta_bytes": 1024,
+    "rss_slope_bytes_per_s": 102.4, "wal_delta_bytes": 0,
+    "wal_growth_bytes_per_s": 0.0, "flightrec_drop_per_s": 0.1,
+    "telemetry_jsonl_bytes": 2048,
+    "last": {"rss_bytes": 100_000_000, "wal_bytes": 4096,
+             "occupancy": 0.875, "uptime_s": 12.0},
+}
+
+
+class FleetAggregatorTests(unittest.TestCase):
+    def test_degenerate_single_process_mode(self):
+        agg = FleetAggregator("local", lambda: dict(TREND_DOC))
+        doc = agg.statusz()
+        self.assertTrue(doc["degenerate"])
+        self.assertEqual(doc["hosts"], 1)
+        self.assertEqual(doc["errors"], [])
+        row = doc["rows"]["local"]
+        self.assertEqual(row["rss_bytes"], 100_000_000)
+        self.assertEqual(row["occupancy"], 0.875)
+        self.assertEqual(row["telemetry_jsonl_bytes"], 2048)
+        # one host = no skew to report
+        self.assertEqual(doc["max_skew"], {})
+        self.assertTrue(json.dumps(doc))
+
+    def test_peer_merge_over_loopback_http(self):
+        """Host 0 pulls a real peer /statusz over the metrics exporter
+        and merges the trend into per-host rows + max-skew."""
+        peer_metrics = Metrics()
+        peer_trend = dict(TREND_DOC)
+        peer_trend["last"] = dict(TREND_DOC["last"],
+                                  rss_bytes=160_000_000)
+        peer_metrics.add_status_source("trend", lambda: peer_trend)
+        port = peer_metrics.start_exporter(0, addr="127.0.0.1")
+        try:
+            agg = FleetAggregator("host0", lambda: dict(TREND_DOC),
+                                  peers=[f"127.0.0.1:{port}"])
+            doc = agg.statusz()
+        finally:
+            peer_metrics.stop_exporter()
+        self.assertFalse(doc["degenerate"])
+        self.assertEqual(doc["hosts"], 2)
+        self.assertEqual(doc["errors"], [])
+        peer_row = doc["rows"][f"127.0.0.1:{port}"]
+        self.assertEqual(peer_row["rss_bytes"], 160_000_000)
+        skew = doc["max_skew"]["rss_bytes"]
+        self.assertEqual(skew["abs_skew"], 30_000_000)
+
+    def test_dead_peer_degrades_to_error_row(self):
+        agg = FleetAggregator("host0", lambda: dict(TREND_DOC),
+                              peers=["127.0.0.1:1"], timeout_s=0.2)
+        doc = agg.statusz()
+        self.assertEqual(doc["errors"], ["127.0.0.1:1"])
+        self.assertIn("error", doc["rows"]["127.0.0.1:1"])
+        # the local row still renders — a sick peer must not blank the
+        # fleet section
+        self.assertIn("rss_bytes", doc["rows"]["host0"])
+
+
+# ---------------------------------------------------------------------------
+# telemetry: startup rotation, jsonl size, observer hook
+# ---------------------------------------------------------------------------
+
+class TelemetryRotation(unittest.TestCase):
+    def test_oversized_preexisting_file_rotates_at_startup(self):
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "soak.jsonl")
+            with open(path, "w") as f:
+                for i in range(10):
+                    f.write(json.dumps({"seq": i}) + "\n")
+            sampler = TelemetrySampler(interval_s=60, out_path=path,
+                                       window=4, max_file_samples=10)
+            with open(path) as f:
+                lines = f.readlines()
+            # rewritten down to the retained window, newest last
+            self.assertEqual(len(lines), 4)
+            self.assertEqual(json.loads(lines[-1])["seq"], 9)
+            self.assertEqual(sampler._written, 4)
+
+    def test_undersized_file_counts_into_the_bound(self):
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "soak.jsonl")
+            with open(path, "w") as f:
+                for i in range(3):
+                    f.write(json.dumps({"seq": i}) + "\n")
+            sampler = TelemetrySampler(interval_s=60, out_path=path,
+                                       window=4, max_file_samples=5)
+            self.assertEqual(sampler._written, 3)
+            # two more appends hit the bound and trigger the rewrite
+            sampler.sample_now()
+            sampler.sample_now()
+            sampler.sample_now()
+            with open(path) as f:
+                lines = f.readlines()
+            self.assertLessEqual(len(lines), 4)
+
+    def test_sample_carries_jsonl_size_and_trend_surfaces_it(self):
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "soak.jsonl")
+            sampler = TelemetrySampler(interval_s=60, out_path=path)
+            sampler.sample_now()
+            doc = sampler.sample_now()  # file exists by the 2nd sample
+            self.assertGreater(doc["telemetry_jsonl_bytes"], 0)
+            trend = sampler.trend()
+            self.assertGreater(trend["telemetry_jsonl_bytes"], 0)
+
+    def test_observer_hook_sees_samples_and_never_breaks(self):
+        seen = []
+
+        def bad_observer(doc):
+            raise RuntimeError("observer bug")
+
+        sampler = TelemetrySampler(interval_s=60)
+        sampler.add_observer(bad_observer).add_observer(seen.append)
+        doc = sampler.sample_now()
+        self.assertEqual(len(seen), 1)
+        self.assertEqual(seen[0]["seq"], doc["seq"])
+
+
+class StageMeansSeries(unittest.TestCase):
+    def test_stage_means_difference_profiler_totals(self):
+        """stage_means_s is the per-sample mean over the calls since the
+        LAST sample — the stage_time_spike detector's input series."""
+        class StubProfiler:
+            def __init__(self):
+                self.totals = {}
+
+            def stage_totals(self):
+                return self.totals
+
+        prof = StubProfiler()
+        sampler = TelemetrySampler(interval_s=60, profiler=prof)
+        d1 = sampler.sample_now()
+        self.assertNotIn("stage_means_s", d1)  # no calls yet
+        prof.totals = {"verify_batch/dispatch":
+                       {"count": 4, "total_s": 0.8}}
+        d2 = sampler.sample_now()
+        self.assertEqual(d2["stage_means_s"]["verify_batch/dispatch"],
+                         0.2)
+        # no new calls -> no series entry (a stale mean would flatline
+        # the EWMA baseline)
+        d3 = sampler.sample_now()
+        self.assertNotIn("stage_means_s", d3)
+
+
+# ---------------------------------------------------------------------------
+# anomaly detectors
+# ---------------------------------------------------------------------------
+
+class EwmaSeriesTests(unittest.TestCase):
+    def test_warmup_then_z(self):
+        s = EwmaSeries(alpha=0.3, min_samples=3)
+        self.assertIsNone(s.update(1.0))
+        self.assertIsNone(s.update(1.1))
+        self.assertIsNone(s.update(0.9))
+        z = s.update(10.0)
+        self.assertIsNotNone(z)
+        self.assertGreater(z, 4.0)
+
+    def test_flat_baseline_departure_is_infinite(self):
+        s = EwmaSeries(min_samples=2)
+        s.update(1.0)
+        s.update(1.0)
+        self.assertEqual(s.update(1.0), 0.0)
+        self.assertEqual(s.update(2.0), float("inf"))
+        s2 = EwmaSeries(min_samples=2)
+        s2.update(1.0)
+        s2.update(1.0)
+        self.assertEqual(s2.update(0.5), float("-inf"))
+
+
+class AnomalyDetectorTests(unittest.TestCase):
+    def _detector(self, **kw):
+        m = Metrics()
+        rec = FlightRecorder(64)
+        det = AnomalyDetector(metrics=m, recorder=rec, **kw)
+        return det, m, rec
+
+    def test_occupancy_collapse(self):
+        det, m, rec = self._detector(min_samples=3)
+        for _ in range(5):
+            det.observe_sample({"occupancy": 0.9})
+        det.observe_sample({"occupancy": 0.05})
+        self.assertEqual(det.alert_count("occupancy_collapse"), 1)
+        s = snapshot(m.registry)
+        self.assertEqual(
+            s["obs_alerts_total{kind=occupancy_collapse}"], 1)
+        alerts = [e for e in rec.tail() if e["kind"] == "alert"]
+        self.assertEqual(alerts[-1]["occupancy"], 0.05)
+        # a HIGH occupancy departure is never an incident
+        det2, _, _ = self._detector(min_samples=3)
+        for _ in range(5):
+            det2.observe_sample({"occupancy": 0.5})
+        det2.observe_sample({"occupancy": 1.0})
+        self.assertEqual(det2.alert_count(), 0)
+
+    def test_stage_time_spike(self):
+        det, _, rec = self._detector(min_samples=3)
+        for _ in range(5):
+            det.observe_sample(
+                {"stage_means_s": {"verify_batch/dispatch": 0.01}})
+        det.observe_sample(
+            {"stage_means_s": {"verify_batch/dispatch": 5.0}})
+        self.assertEqual(det.alert_count("stage_time_spike"), 1)
+        alerts = [e for e in rec.tail() if e["kind"] == "alert"]
+        self.assertEqual(alerts[-1]["stage"], "verify_batch/dispatch")
+
+    def test_shed_storm(self):
+        det, _, _ = self._detector(min_samples=3)
+        for total in (0, 0, 0, 0, 0, 0):
+            det.observe_sample(
+                {"counters": {"frontier_admission_sheds_total": total}})
+        det.observe_sample(
+            {"counters": {"frontier_admission_sheds_total": 500}})
+        self.assertEqual(det.alert_count("shed_storm"), 1)
+
+    def test_straggler_persistence(self):
+        class StubStraggler:
+            def __init__(self):
+                self.flags = 0
+
+            def flag_count(self):
+                return self.flags
+
+            def flagged_devices(self):
+                return ["cpu:3"]
+
+        stub = StubStraggler()
+        det = AnomalyDetector(straggler=stub, straggler_window=5,
+                              straggler_min_flagged=3)
+        for _ in range(2):  # two flagged samples: below the bar
+            stub.flags += 1
+            det.observe_sample({})
+        self.assertEqual(det.alert_count("straggler_persistence"), 0)
+        stub.flags += 1
+        det.observe_sample({})  # third flagged sample in the window
+        self.assertEqual(det.alert_count("straggler_persistence"), 1)
+        alerts = det.tail()
+        self.assertEqual(alerts[-1]["devices"], ["cpu:3"])
+        # the window cleared: persistence must re-accumulate
+        stub.flags += 1
+        det.observe_sample({})
+        self.assertEqual(det.alert_count("straggler_persistence"), 1)
+
+    def test_statusz_and_synthetic_alerts(self):
+        det, m, rec = self._detector()
+        for i in range(3):
+            det.raise_alert("synthetic_storm", index=i)
+        doc = det.statusz(tail=2)
+        self.assertEqual(doc["total"], 3)
+        self.assertEqual(doc["by_kind"], {"synthetic_storm": 3})
+        self.assertEqual(len(doc["recent"]), 2)
+        self.assertEqual(det.alert_count(), 3)
+        s = snapshot(m.registry)
+        self.assertEqual(s["obs_alerts_total{kind=synthetic_storm}"], 3)
+        self.assertEqual(
+            len([e for e in rec.tail() if e["kind"] == "alert"]), 3)
+        self.assertTrue(json.dumps(doc))
+
+    def test_observe_sample_never_raises(self):
+        det, _, _ = self._detector()
+        det.observe_sample({"occupancy": "not-a-number",
+                            "stage_means_s": "nope",
+                            "counters": None})
+        det.observe_sample(None)  # type: ignore[arg-type]
+        self.assertEqual(det.alert_count(), 0)
+
+
+# ---------------------------------------------------------------------------
+# waterfall reconstruction
+# ---------------------------------------------------------------------------
+
+SUMMARY_FIXTURE = {
+    "profile": {
+        "recent": [
+            {"seq": 1, "ts": 100.0, "op": "verify_batch", "batch": 8,
+             "ok": True, "round_id": 1,
+             "stages_s": {"parse": 0.001, "dispatch": 0.004,
+                          "readback": 0.002, "pairing": 0.003},
+             "stages_at_s": {"parse": 0.001, "dispatch": 0.005,
+                             "readback": 0.007, "pairing": 0.010}},
+            {"seq": 2, "ts": 101.0, "op": "verify_batch", "batch": 8,
+             "ok": True, "round_id": 2,
+             "stages_s": {"parse": 0.001, "dispatch": 0.004},
+             "stages_at_s": {"parse": 0.001, "dispatch": 0.005}},
+            {"seq": 3, "ts": 102.0, "op": "aggregate", "batch": 4,
+             "ok": True, "round_id": 3,
+             "stages_s": {"parse": 0.002, "dispatch": 0.006}},
+        ],
+    },
+    "flightrec": [
+        {"seq": 1, "ts": 100.0, "kind": "round_flush", "round_id": 1,
+         "batch": 8, "queue_wait_s": 0.002},
+        {"seq": 2, "ts": 100.5, "kind": "straggler", "round_id": 1,
+         "device": "cpu:3", "stage": "readback", "skew": 2.1},
+        {"seq": 3, "ts": 103.0, "kind": "alert", "round_id": 3,
+         "alert_kind": "stage_time_spike"},
+    ],
+}
+
+
+class WaterfallScript(unittest.TestCase):
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, WATERFALL, *argv],
+            capture_output=True, text=True, timeout=60)
+
+    def test_reconstructs_rounds_with_ring_ordering(self):
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "summary.json")
+            with open(path, "w") as f:
+                json.dump(SUMMARY_FIXTURE, f)
+            proc = self._run(path, "--json")
+            self.assertEqual(proc.returncode, 0, proc.stderr)
+            doc = json.loads(proc.stdout)
+        self.assertEqual(doc["count"], 3)
+        r1 = doc["rounds"][0]
+        self.assertEqual(r1["round_id"], 1)
+        # queue wait leads (negative offset anchors flush at 0), then
+        # the ring's stage order: parse -> dispatch -> readback ->
+        # pairing, exactly the stages_at_s sequence
+        names = [s["stage"] for s in r1["segments"]]
+        self.assertEqual(names, ["queue_wait", "parse", "dispatch",
+                                 "readback", "pairing"])
+        starts = [s["start_s"] for s in r1["segments"]]
+        self.assertEqual(starts, sorted(starts))
+        # annotations ride their round
+        self.assertEqual(r1["annotations"][0]["device"], "cpu:3")
+        self.assertEqual(doc["rounds"][2]["annotations"][0]["kind"],
+                         "alert")
+        # legacy record without stages_at_s still orders by stage rank
+        r3 = doc["rounds"][2]
+        seg_names = [s["stage"] for s in r3["segments"]]
+        self.assertEqual(seg_names, ["parse", "dispatch"])
+
+    def test_text_rendering_and_empty_input_exit_codes(self):
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "summary.json")
+            with open(path, "w") as f:
+                json.dump(SUMMARY_FIXTURE, f)
+            proc = self._run(path)
+            self.assertEqual(proc.returncode, 0, proc.stderr)
+            self.assertIn("round 1", proc.stdout)
+            self.assertIn("queue_wait", proc.stdout)
+            self.assertIn("rounds: 3", proc.stdout)
+            empty = os.path.join(td, "empty.json")
+            with open(empty, "w") as f:
+                json.dump({"profile": {"recent": []}}, f)
+            proc2 = self._run(empty)
+            self.assertEqual(proc2.returncode, 4)
+
+
+if __name__ == "__main__":
+    unittest.main()
