@@ -32,6 +32,10 @@ LOCK_FILE_GLOBS = (
     "consensus_overlord_tpu/crypto/breaker.py",
     "consensus_overlord_tpu/crypto/tpu_provider.py",
     "consensus_overlord_tpu/obs/telemetry.py",
+    # r18: the mesh supervisor's ladder state is fed from the frontier's
+    # dispatch worker and resolver threads concurrently — same one-lock
+    # convention as the breaker it sits beside.
+    "consensus_overlord_tpu/parallel/supervisor.py",
 )
 
 DEVICE_FILE_GLOBS = (

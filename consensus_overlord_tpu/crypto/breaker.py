@@ -34,13 +34,35 @@ from typing import Optional
 
 logger = logging.getLogger("consensus_overlord_tpu.breaker")
 
-__all__ = ["CircuitBreaker", "InjectedDeviceFault"]
+__all__ = ["CircuitBreaker", "DeviceLossError", "DispatchTimeout",
+           "InjectedDeviceFault"]
 
 
 class InjectedDeviceFault(RuntimeError):
     """Raised by `CircuitBreaker.raise_if_injected` while a fault window
     is armed — the chaos harness's stand-in for an XLA runtime error or
     a torn PJRT link on the device dispatch/readback path."""
+
+
+class DispatchTimeout(RuntimeError):
+    """A device dispatch/readback overran its watchdog deadline
+    (tpu_provider `dispatch_deadline_s`).  Flows through the caller's
+    normal device-failure handling — breaker failure + exact host-oracle
+    re-verify — so a wedged collective degrades throughput, never
+    liveness.  The abandoned readback keeps its daemon worker thread
+    until the device returns; the breaker routes traffic host-side in
+    the meantime."""
+
+
+class DeviceLossError(RuntimeError):
+    """A mesh lane is lost (chaos `device_loss`, or a real torn lane
+    surfaced by the runtime): dispatches touching `device` raise instead
+    of completing.  Carries the device name so the MeshSupervisor can
+    quarantine the exact lane and rebuild a survivor sub-mesh."""
+
+    def __init__(self, device: str, message: str = ""):
+        super().__init__(message or f"mesh lane lost ({device})")
+        self.device = device
 
 CLOSED = "closed"
 OPEN = "open"
@@ -68,6 +90,10 @@ class CircuitBreaker:
         self.total_failures = 0
         self.total_fallbacks = 0
         self.times_opened = 0
+        #: Last record_failure reason ("" until the first failure) — the
+        #: one line that makes a half-open flap diagnosable from
+        #: /statusz alone.
+        self._last_failure_reason = ""
         #: Fault-injection window (sim/chaos.py `device_fault` events):
         #: while armed, device paths that call raise_if_injected() fail,
         #: driving the real open → fallback → half-open → closed cycle.
@@ -178,6 +204,8 @@ class CircuitBreaker:
     def record_failure(self, reason: str = "") -> None:
         with self._lock:
             self.total_failures += 1
+            if reason:
+                self._last_failure_reason = reason
             self._probe_inflight = False
             if self._state == HALF_OPEN:
                 # The probe failed: straight back to open, fresh cooldown.
@@ -198,12 +226,18 @@ class CircuitBreaker:
     def status(self) -> dict:
         """JSON-encodable snapshot for /statusz."""
         with self._lock:
+            cooldown_remaining = 0.0
+            if self._state == OPEN and self._opened_at is not None:
+                cooldown_remaining = max(
+                    0.0, self.cooldown_s - (self._clock() - self._opened_at))
             return {
                 "state": self._state,
                 "consecutive_failures": self._consecutive_failures,
                 "total_failures": self.total_failures,
                 "total_fallbacks": self.total_fallbacks,
                 "times_opened": self.times_opened,
+                "last_failure_reason": self._last_failure_reason,
+                "cooldown_remaining_s": round(cooldown_remaining, 4),
                 "fault_injected": self._inject_armed_locked(),
                 "total_injected": self.total_injected,
             }

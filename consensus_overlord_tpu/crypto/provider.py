@@ -280,7 +280,7 @@ class SimDeviceCrypto:
     Signing and hashing stay direct (keys are host-side on the real
     provider too, SURVEY.md §7 hard part (e))."""
 
-    def __init__(self, base, breaker=None, metrics=None):
+    def __init__(self, base, breaker=None, metrics=None, lanes: int = 8):
         from .breaker import CircuitBreaker
 
         self._base = base
@@ -295,6 +295,21 @@ class SimDeviceCrypto:
         #: dispatch stage carries it and occupancy is always 1.0) — CPU
         #: fleets exercise the full profile surface with zero hardware.
         self.prof = None
+        #: The pretend mesh inventory ("sim:N" lane names): what the
+        #: MeshSupervisor's sub_mesh rung quarantines against when chaos
+        #: names a lane.  Purely nominal — there is one host under it.
+        self._lanes = max(int(lanes), 1)
+        #: Optional MeshSupervisor (parallel/supervisor.py): the sim
+        #: provider has no kernel sets to swap (no apply_mesh_rung), so
+        #: the supervisor walks the ladder as bookkeeping — chaos runs
+        #: exercise the transition logic, metrics, and statusz surface
+        #: with zero hardware.
+        self._supervisor = None
+        #: Chaos windows, mirroring TpuBlsCrypto's hooks: lane-loss
+        #: {name: monotonic-until} and the dcn_stall deadline-overrun
+        #: window.
+        self._lost_lanes: dict = {}
+        self._dcn_stall_until = 0.0
 
     def bind_metrics(self, metrics) -> None:
         self.metrics = metrics
@@ -302,6 +317,72 @@ class SimDeviceCrypto:
 
     def bind_profiler(self, prof) -> None:
         self.prof = prof
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Attach a MeshSupervisor: device outcomes walk its ladder and
+        its allow_device() gate joins the breaker's."""
+        self._supervisor = supervisor
+
+    def mesh_device_names(self) -> List[str]:
+        """Nominal lane inventory for supervisor quarantine/sub-mesh
+        bookkeeping (the sim 'mesh' is one host; names are synthetic)."""
+        return [f"sim:{i}" for i in range(self._lanes)]
+
+    def _lane_name(self, device) -> str:
+        if isinstance(device, int) or (isinstance(device, str)
+                                       and device.isdigit()):
+            return f"sim:{int(device) % self._lanes}"
+        return str(device)
+
+    def inject_device_loss(self, device, seconds: float) -> None:
+        """Chaos hook (sim `device_loss`): for `seconds`, dispatches
+        raise DeviceLossError naming the lane — until the supervisor
+        quarantines it, after which dispatch runs clean (the sub-mesh
+        rebuild, modeled).  seconds <= 0 clears the lane."""
+        name = self._lane_name(device)
+        if seconds > 0:
+            self._lost_lanes[name] = time.monotonic() + float(seconds)
+        else:
+            self._lost_lanes.pop(name, None)
+
+    def inject_dcn_stall(self, seconds: float) -> None:
+        """Chaos hook (sim `dcn_stall`): for `seconds`, dispatches stall
+        briefly and raise DispatchTimeout — the watchdog's verdict on a
+        wedged collective, modeled without holding the sim for the full
+        stall.  seconds <= 0 clears the window."""
+        if seconds > 0:
+            self._dcn_stall_until = time.monotonic() + float(seconds)
+        else:
+            self._dcn_stall_until = 0.0
+
+    def _raise_chaos_fault(self, path: str) -> None:
+        """Raise the armed mesh-chaos fault, if any (expired windows
+        self-clear).  A lane the supervisor already quarantined no
+        longer faults — the modeled survivor sub-mesh."""
+        from .breaker import DeviceLossError, DispatchTimeout
+
+        now = time.monotonic()
+        if self._dcn_stall_until > 0.0:
+            if now < self._dcn_stall_until:
+                # The real watchdog cuts a wedged call at its deadline;
+                # the sim models the wedge with a token stall so chaos
+                # runs pay latency, not the whole window.
+                time.sleep(0.005)
+                raise DispatchTimeout(
+                    f"{path}: simulated dispatch deadline overrun")
+            self._dcn_stall_until = 0.0
+        if not self._lost_lanes:
+            return
+        sup = self._supervisor
+        quarantined = set(sup.quarantined_devices()) if sup is not None \
+            else set()
+        for name, until in list(self._lost_lanes.items()):
+            if now >= until:
+                self._lost_lanes.pop(name, None)
+                continue
+            if name not in quarantined:
+                raise DeviceLossError(
+                    name, f"{path}: injected loss of lane {name}")
 
     def degraded_status(self) -> dict:
         """Breaker + fallback state for /statusz ("crypto" section)."""
@@ -324,14 +405,22 @@ class SimDeviceCrypto:
         which here is the same function, so results are always exact.
         A bound profiler sees the same staged-profile surface as the
         real device path (dispatch = the simulated device call)."""
+        sup = self._supervisor
+        if sup is not None and not sup.allow_device():
+            if self.metrics is not None:
+                self.metrics.host_fallbacks.labels(path=path).inc()
+            return fn(*args)
         if not self.breaker.allow():
             if self.metrics is not None:
                 self.metrics.host_fallbacks.labels(path=path).inc()
             return fn(*args)
         try:
             self.breaker.raise_if_injected(path)
+            self._raise_chaos_fault(path)
         except Exception as e:  # noqa: BLE001 — injected device fault
             self.breaker.record_failure(f"{path}: {type(e).__name__}")
+            if sup is not None:
+                sup.record_failure(path, e)
             if self.metrics is not None:
                 self.metrics.device_failures.labels(path=path).inc()
                 self.metrics.host_fallbacks.labels(path=path).inc()
@@ -344,7 +433,7 @@ class SimDeviceCrypto:
             return fn(*args)
         if self.prof is None:
             result = fn(*args)
-            self.breaker.record_success()
+            self._record_device_success()
             return result
         call = self.prof.begin(path, batch)
         call.pad(batch, batch)  # no pad ladder: the sim batch ships as-is
@@ -357,8 +446,13 @@ class SimDeviceCrypto:
             raise
         call.observe("dispatch", time.perf_counter() - t0)
         call.finish()
-        self.breaker.record_success()
+        self._record_device_success()
         return result
+
+    def _record_device_success(self) -> None:
+        self.breaker.record_success()
+        if self._supervisor is not None:
+            self._supervisor.record_success()
 
     def verify_signature(self, signature: bytes, hash32: bytes,
                          voter: bytes) -> bool:

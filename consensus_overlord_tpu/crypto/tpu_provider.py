@@ -54,7 +54,7 @@ from ..compile_cache import enable as _enable_compile_cache
 from ..core.sm3 import sm3_hash
 from ..obs.fleet import current_round_id
 from ..obs.prof import NULL_CALL, annotate
-from .breaker import CircuitBreaker
+from .breaker import CircuitBreaker, DeviceLossError, DispatchTimeout
 
 # The provider's kernels are the big compiles; make sure every process
 # that imports them shares the machine-wide persistent cache.
@@ -381,7 +381,8 @@ class TpuBlsCrypto:
                  qc_device_threshold: Optional[int] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  device_pairing: Optional[bool] = None,
-                 g2_table_msm: Optional[bool] = None):
+                 g2_table_msm: Optional[bool] = None,
+                 dispatch_deadline_s: Optional[float] = None):
         """mesh: optional jax.sharding.Mesh — batches then shard across its
         devices through the parallel/sharded.py kernels (single-chip jits
         otherwise).  Pass parallel.make_mesh() to use every local device.
@@ -419,15 +420,52 @@ class TpuBlsCrypto:
         (ops/curve.py msm_table_build — the bench_g2_table_msm.py
         experiment promoted).  None reads CONSENSUS_G2_TABLE_MSM
         (default off: tables cost ~240 KB of HBM per cached pubkey
-        row).  Single-chip kernels only."""
+        row).  Single-chip kernels only.
+
+        dispatch_deadline_s: watchdog deadline for each blocking device
+        call (the readback end of a dispatch — JAX dispatch itself is
+        asynchronous, so a wedged collective surfaces at device_get).
+        Scaled by the batch rung (_deadline_for); a call that overruns
+        becomes a DispatchTimeout breaker failure with an exact host
+        re-verify instead of blocking the frontier worker forever.
+        None reads CONSENSUS_DISPATCH_DEADLINE_S; <= 0 disables the
+        watchdog (the pre-r18 unbounded behavior)."""
         self._cpu = CpuBlsCrypto(private_key, common_ref)
         self._common_ref = common_ref
         self._threshold = device_threshold
         self._qc_threshold = (qc_device_threshold
                               if qc_device_threshold is not None
                               else device_threshold)
-        self._kernels = (_MeshKernels(mesh) if mesh is not None
-                         and mesh.devices.size > 1 else _SingleChipKernels)
+        #: The configured full mesh (None = single-chip provider) — the
+        #: ladder's top rung and the inventory sub-mesh rebuilds
+        #: subtract quarantined lanes from.
+        self._mesh = (mesh if mesh is not None
+                      and mesh.devices.size > 1 else None)
+        self._kernels = (_MeshKernels(self._mesh) if self._mesh is not None
+                         else _SingleChipKernels)
+        #: The full-rung kernel set, kept so stepping back up to
+        #: full_mesh reuses the already-wrapped (and already-compiled)
+        #: kernels instead of rebuilding them.
+        self._full_kernels = self._kernels
+        if dispatch_deadline_s is None:
+            dispatch_deadline_s = float(os.environ.get(
+                "CONSENSUS_DISPATCH_DEADLINE_S", "0"))
+        #: Watchdog deadline base (see ctor docstring); <= 0 = off.
+        self._dispatch_deadline_s = float(dispatch_deadline_s)
+        #: Chaos hook (dcn_stall): monotonic timestamp until which every
+        #: watched device call wedges — the fault the watchdog converts
+        #: to a DispatchTimeout.  0.0 = clear.
+        self._dcn_stall_until = 0.0
+        #: Chaos hook (device_loss): {device_name: monotonic-until} —
+        #: while armed, any dispatch whose CURRENT kernel set contains
+        #: that lane raises DeviceLossError (carrying the lane name for
+        #: supervisor quarantine).  A rebuilt sub-mesh that excludes the
+        #: lane dispatches clean — exactly the self-healing contract.
+        self._inject_loss: dict = {}
+        #: Optional MeshSupervisor (parallel/supervisor.py): fed from
+        #: _device_failed/_device_succeeded, consulted in
+        #: _device_allowed, swaps kernel sets via apply_mesh_rung.
+        self._supervisor = None
         single_chip = getattr(self._kernels, "mesh", None) is None
         if device_pairing is None:
             mode = os.environ.get("CONSENSUS_DEVICE_PAIRING", "auto")
@@ -468,8 +506,11 @@ class TpuBlsCrypto:
         # Guards the cache arrays + index: the frontier's dispatch worker
         # and a service-thread reconfigure can race update_pubkeys, and an
         # interleaved base-capture/concatenate would desynchronize the
-        # row offsets from the coordinate arrays.
-        self._pk_lock = threading.Lock()
+        # row offsets from the coordinate arrays.  RLock: a device
+        # failure inside _update_pubkeys_locked can walk the supervisor
+        # ladder down, and the resulting kernel swap (_swap_kernels)
+        # must invalidate the device cache under this same lock.
+        self._pk_lock = threading.RLock()
         self._pk_px = np.zeros((0, 2, dev.FQ.n), np.int32)
         self._pk_py = np.zeros((0, 2, dev.FQ.n), np.int32)
         self._pk_pz = np.zeros((0, 2, dev.FQ.n), np.int32)
@@ -592,7 +633,16 @@ class TpuBlsCrypto:
         return dev.FQ.from_int(h_pt[0]), dev.FQ.from_int(h_pt[1])
 
     def _device_allowed(self, path: str) -> bool:
-        """Ask the breaker; count the fallback when routed to host."""
+        """Ask the supervisor's ladder gate, then the breaker; count the
+        fallback when routed to host.  On the host_oracle rung the
+        supervisor says no while its probe cadence (record_success from
+        the breaker's own half-open probes and small-batch host wins)
+        steps the ladder back up."""
+        sup = self._supervisor
+        if sup is not None and not sup.allow_device():
+            if self.metrics is not None:
+                self.metrics.host_fallbacks.labels(path=path).inc()
+            return False
         if self.breaker.allow():
             return True
         if self.metrics is not None:
@@ -600,14 +650,226 @@ class TpuBlsCrypto:
         return False
 
     def _device_failed(self, path: str, exc: BaseException) -> None:
-        """One device dispatch/readback failure: feed the breaker, count
-        it, log it.  The caller then falls back to the host oracle."""
+        """One device dispatch/readback failure: feed the breaker (and
+        the mesh supervisor's ladder), count it, log it.  The caller
+        then falls back to the host oracle."""
         logger.warning("device path %s failed (%s: %s); host fallback",
                        path, type(exc).__name__, exc)
         self.breaker.record_failure(f"{path}: {type(exc).__name__}")
+        sup = self._supervisor
+        if sup is not None:
+            sup.record_failure(path, exc)
         if self.metrics is not None:
             self.metrics.device_failures.labels(path=path).inc()
             self.metrics.host_fallbacks.labels(path=path).inc()
+
+    def _device_succeeded(self) -> None:
+        """One clean device resolve: close the breaker loop AND feed the
+        supervisor's step-up probe counter (real traffic is the probe)."""
+        self.breaker.record_success()
+        sup = self._supervisor
+        if sup is not None:
+            sup.record_success()
+
+    # -- mesh resilience (watchdog + supervisor + chaos hooks) ---------------
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Attach a MeshSupervisor (parallel/supervisor.py): from here on
+        device outcomes walk its escalation ladder and apply_mesh_rung
+        swaps this provider's kernel set on transitions."""
+        self._supervisor = supervisor
+
+    def mesh_device_names(self) -> List[str]:
+        """The configured full-mesh lane inventory ("platform:id" names,
+        matching the straggler detector's) — what sub-mesh rebuilds
+        subtract quarantined lanes from.  Empty for single-chip
+        providers (no sub_mesh rung exists)."""
+        if self._mesh is None:
+            return []
+        return [f"{d.platform}:{d.id}" for d in self._mesh.devices.flat]
+
+    def _current_lane_names(self) -> List[str]:
+        """Lane names of the CURRENT kernel set (shrinks on sub-mesh
+        rungs — a quarantined lost lane no longer blackholes dispatch)."""
+        mesh = getattr(self._kernels, "mesh", None)
+        if mesh is not None:
+            return [f"{d.platform}:{d.id}" for d in mesh.devices.flat]
+        try:
+            d = jax.devices()[0]
+        except Exception:  # noqa: BLE001 — backend gone: no lanes to name
+            logger.warning("jax.devices() failed resolving lane names")
+            return []
+        return [f"{d.platform}:{d.id}"]
+
+    def _lane_name(self, device) -> str:
+        """Normalize a chaos target (lane index or "platform:id" name)
+        against the full-mesh inventory."""
+        names = self.mesh_device_names() or self._current_lane_names()
+        if isinstance(device, int) or (isinstance(device, str)
+                                       and device.isdigit()):
+            return names[int(device) % len(names)] if names else str(device)
+        return str(device)
+
+    def inject_device_loss(self, device, seconds: float) -> None:
+        """Chaos hook (sim `device_loss`): for `seconds`, any dispatch
+        whose current kernel set contains `device` (lane index or
+        "platform:id" name) raises DeviceLossError carrying the lane
+        name — the supervisor quarantines it and rebuilds a survivor
+        sub-mesh, after which dispatches run clean while the window is
+        still live.  seconds <= 0 clears the lane."""
+        name = self._lane_name(device)
+        if seconds > 0:
+            self._inject_loss[name] = time.monotonic() + float(seconds)
+            logger.warning("device_loss armed: lane %s for %.2fs",
+                           name, seconds)
+        else:
+            self._inject_loss.pop(name, None)
+
+    def inject_dcn_stall(self, seconds: float) -> None:
+        """Chaos hook (sim `dcn_stall`): for `seconds`, every watched
+        device call wedges inside its dispatch window — the fault the
+        watchdog converts to a DispatchTimeout within
+        dispatch_deadline_s.  Compose with inject_straggler() to give
+        the straggler detector the same degraded-lane signal.
+        seconds <= 0 clears the window."""
+        if seconds > 0:
+            self._dcn_stall_until = time.monotonic() + float(seconds)
+            logger.warning("dcn_stall armed for %.2fs", seconds)
+        else:
+            self._dcn_stall_until = 0.0
+
+    def _dcn_stall_remaining(self) -> float:
+        until = self._dcn_stall_until
+        if until <= 0.0:
+            return 0.0
+        remaining = until - time.monotonic()
+        if remaining <= 0.0:
+            self._dcn_stall_until = 0.0
+            return 0.0
+        return remaining
+
+    def _raise_if_lost(self, path: str) -> None:
+        """Raise DeviceLossError when an armed lane loss targets a lane
+        of the CURRENT kernel set (expired windows self-clear)."""
+        if not self._inject_loss:
+            return
+        now = time.monotonic()
+        current = None
+        for name, until in list(self._inject_loss.items()):
+            if now >= until:
+                self._inject_loss.pop(name, None)
+                continue
+            if current is None:
+                current = set(self._current_lane_names())
+            if name in current:
+                raise DeviceLossError(
+                    name, f"{path}: injected loss of lane {name}")
+
+    def _deadline_for(self, size: int) -> Optional[float]:
+        """Watchdog deadline for one blocking device call, scaled by the
+        batch rung: sqrt of the rung ratio — MSM work grows ~linearly
+        with the rung, but fixed dispatch overhead dominates the small
+        rungs, so linear scaling would let an 8192-lane deadline grow
+        1024x.  None = watchdog off."""
+        base = self._dispatch_deadline_s
+        if base <= 0:
+            return None
+        return base * max(1.0, (max(int(size), 1) / _PAD_SIZES[0]) ** 0.5)
+
+    def _watched(self, fn, *args, size: int = 0, path: str = "dispatch"):
+        """Run one blocking device call (readback, or validate+readback)
+        under the dispatch watchdog.  JAX dispatch is asynchronous, so a
+        wedged collective surfaces at the blocking device_get — the
+        chokepoint every device path funnels through.  Raises
+        DeviceLossError while an injected lane loss targets the current
+        kernel set and DispatchTimeout when the rung-scaled deadline
+        expires; both flow through the caller's existing failure
+        handling (breaker + supervisor + exact host fallback).  With the
+        watchdog off this is a plain call (plus the chaos stall, which
+        then wedges for real — the pre-r18 behavior under a wedged
+        link)."""
+        self._raise_if_lost(path)
+        deadline = self._deadline_for(size)
+        if deadline is None:
+            stall = self._dcn_stall_remaining()
+            if stall > 0.0:
+                time.sleep(stall)
+            return fn(*args)
+        box: dict = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                stall = self._dcn_stall_remaining()
+                if stall > 0.0:
+                    time.sleep(stall)  # the wedge the deadline cuts short
+                box["result"] = fn(*args)
+            # Not swallowed: the caller re-raises this on its own
+            # thread right below (unless the deadline fired first, in
+            # which case DispatchTimeout already took the failure path).
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+            finally:
+                done.set()
+
+        # One daemon thread per watched call, not a pool: a wedged
+        # device call holds its thread until the runtime returns, and a
+        # pool's workers would leak away one wedge at a time until every
+        # dispatch queued forever behind dead slots.
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"dispatch-watchdog-{path}")
+        t.start()
+        if not done.wait(deadline):
+            raise DispatchTimeout(
+                f"{path}: device call exceeded dispatch deadline "
+                f"{deadline:.2f}s (size={size})")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def apply_mesh_rung(self, rung: str, quarantined: Sequence[str]) -> None:
+        """MeshSupervisor hook: swap the kernel set for a ladder rung.
+        full_mesh reuses the ctor's kernel set; sub_mesh rebuilds
+        _MeshKernels over the survivor devices (operands re-pad to the
+        new lane multiple through self._kernels.lanes); single_chip is
+        the module-jit set; host_oracle changes nothing here — the
+        supervisor's allow_device() gate routes dispatch instead."""
+        if rung == "host_oracle":
+            return
+        if rung == "full_mesh" and self._mesh is not None and not quarantined:
+            kernels = self._full_kernels
+        elif rung != "single_chip" and self._mesh is not None:
+            from jax.sharding import Mesh
+            dead = set(quarantined)
+            survivors = [d for d in self._mesh.devices.flat
+                         if f"{d.platform}:{d.id}" not in dead]
+            if len(survivors) >= 2:
+                kernels = _MeshKernels(
+                    Mesh(np.asarray(survivors), self._mesh.axis_names))
+            else:
+                kernels = _SingleChipKernels
+        else:
+            kernels = _SingleChipKernels
+        self._swap_kernels(kernels)
+        logger.warning("mesh rung %s applied: %d lane(s)%s", rung,
+                       kernels.lanes,
+                       f", quarantined={sorted(quarantined)}"
+                       if quarantined else "")
+
+    def _swap_kernels(self, kernels) -> None:
+        """Install a new kernel set and drop every mesh-resident cache
+        placed on the old one (device pubkey copy, G2 tables, the stage
+        probe's twins).  A dispatch racing the swap can mix old/new
+        shapes and fail — that lands in the normal failure handling and
+        re-verifies on the host, costing one batch of throughput, never
+        correctness."""
+        if kernels is self._kernels:
+            return
+        with self._pk_lock:
+            self._kernels = kernels
+            self._pk_dev = None
+            self._pk_tab = None
+        self._stage_probe = None
 
     #: crypto_dispatch_ms phase → crypto_device_stage_seconds stage (the
     #: stage family keeps profile_verify.py's names; "prep" has always
@@ -759,12 +1021,13 @@ class TpuBlsCrypto:
             # D2H round-trip (~150 ms on a remote PJRT link).
             t0 = time.perf_counter()
             try:
-                ax, ay, ainf, valid = jax.device_get(out)
+                ax, ay, ainf, valid = self._watched(
+                    jax.device_get, out, size=size, path="aggregate")
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("aggregate", e)
                 call.finish(ok=False)
                 return self._cpu.aggregate_signatures(signatures, voters)
-            self.breaker.record_success()
+            self._device_succeeded()
             call.observe("readback", time.perf_counter() - t0)
             if not bool(valid[:n].all()):
                 call.finish(ok=False)  # the call raised — never ring ok
@@ -854,16 +1117,19 @@ class TpuBlsCrypto:
                     # Device-pairing path: only the infinity flag is
                     # read here; the aggregate stays on device for the
                     # pairing kernel.
-                    ainf = bool(jax.device_get(out[2]))
+                    ainf = bool(self._watched(jax.device_get, out[2],
+                                              size=size,
+                                              path="verify_aggregated"))
                 else:
-                    agg = jax.device_get(out)
+                    agg = self._watched(jax.device_get, out, size=size,
+                                        path="verify_aggregated")
                     ainf = bool(agg[2])
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_aggregated", e)
                 call.finish(ok=False)
                 return self._cpu.verify_aggregated_signature(
                     agg_sig, hash32, voters)
-            self.breaker.record_success()
+            self._device_succeeded()
             call.observe("readback", time.perf_counter() - t0)
             t0 = time.perf_counter()
             try:
@@ -885,7 +1151,9 @@ class TpuBlsCrypto:
                 result = None
                 if verdict_dev is not None:
                     try:
-                        result = bool(jax.device_get(verdict_dev))
+                        result = bool(self._watched(
+                            jax.device_get, verdict_dev,
+                            path="verify_aggregated"))
                     except Exception as e:  # noqa: BLE001 — readback
                         self._pairing_failed(e)
                         result = None
@@ -894,7 +1162,9 @@ class TpuBlsCrypto:
                     # its dispatch/readback failed above).
                     if agg is None:
                         try:
-                            agg = jax.device_get(out)
+                            agg = self._watched(jax.device_get, out,
+                                                size=size,
+                                                path="verify_aggregated")
                         except Exception as e:  # noqa: BLE001 — readback
                             self._device_failed("verify_aggregated", e)
                             return self._cpu.verify_aggregated_signature(
@@ -1098,16 +1368,18 @@ class TpuBlsCrypto:
             ax = ay = ainf = gx = gy = ginf = None
             try:
                 if slim:
-                    valid = jax.device_get(out[3])
+                    valid = self._watched(jax.device_get, out[3],
+                                          size=size, path="verify_batch")
                 else:
-                    ax, ay, ainf, valid, gx, gy, ginf = jax.device_get(out)
+                    ax, ay, ainf, valid, gx, gy, ginf = self._watched(
+                        jax.device_get, out, size=size, path="verify_batch")
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_batch", e)
                 call.finish(ok=False)
                 return [self._cpu.verify_signature(signatures[i], h,
                                                    voters[i])
                         for i in range(n)]
-            self.breaker.record_success()
+            self._device_succeeded()
             self._observe_phase("readback", t0, call)
             # Per-chip skew sample AFTER the readback stage is observed
             # (compute drained): its extra D2H reads must never inflate
@@ -1122,7 +1394,9 @@ class TpuBlsCrypto:
                 paired = None
                 if verdict_dev is not None:
                     try:
-                        paired = bool(jax.device_get(verdict_dev))
+                        paired = bool(self._watched(
+                            jax.device_get, verdict_dev,
+                            path="verify_batch"))
                         self._observe_phase("pairing", t0, call)
                     except Exception as e:  # noqa: BLE001 — pairing readback
                         self._pairing_failed(e)
@@ -1133,7 +1407,9 @@ class TpuBlsCrypto:
                     if ax is None:
                         try:
                             (ax, ay, ainf, _, gx, gy,
-                             ginf) = jax.device_get(out)
+                             ginf) = self._watched(
+                                 jax.device_get, out, size=size,
+                                 path="verify_batch")
                         except Exception as e:  # noqa: BLE001 — readback
                             self._device_failed("verify_batch", e)
                             return [bool(v[i]) and self._verify_one_cached(
@@ -1221,9 +1497,11 @@ class TpuBlsCrypto:
             flat = None
             try:
                 if slim:
-                    valid = jax.device_get(out[3])
+                    valid = self._watched(jax.device_get, out[3],
+                                          size=size, path="verify_batch")
                 else:
-                    flat = jax.device_get(out)
+                    flat = self._watched(jax.device_get, out,
+                                         size=size, path="verify_batch")
                     valid = flat[3]
             except Exception as e:  # noqa: BLE001 — device readback failed
                 self._device_failed("verify_batch", e)
@@ -1231,7 +1509,7 @@ class TpuBlsCrypto:
                 return [self._cpu.verify_signature(signatures[i],
                                                    lane_hashes[i], voters[i])
                         for i in range(n)]
-            self.breaker.record_success()
+            self._device_succeeded()
             self._observe_phase("readback", t0, call)
             self._shard_latencies(out[3])  # post-readback skew sample
             t0 = time.perf_counter()  # pairing excludes the sample's D2H
@@ -1242,7 +1520,9 @@ class TpuBlsCrypto:
                 paired = None
                 if verdict_dev is not None:
                     try:
-                        paired = bool(jax.device_get(verdict_dev))
+                        paired = bool(self._watched(
+                            jax.device_get, verdict_dev,
+                            path="verify_batch"))
                         self._observe_phase("pairing", t0, call)
                     except Exception as e:  # noqa: BLE001 — pairing readback
                         self._pairing_failed(e)
@@ -1250,7 +1530,9 @@ class TpuBlsCrypto:
                 if paired is None:
                     if flat is None:
                         try:
-                            flat = jax.device_get(out)
+                            flat = self._watched(jax.device_get, out,
+                                                 size=size,
+                                                 path="verify_batch")
                         except Exception as e:  # noqa: BLE001 — readback
                             self._device_failed("verify_batch", e)
                             return [bool(v[i]) and self._verify_one_cached(
@@ -1507,8 +1789,11 @@ class TpuBlsCrypto:
             ok = np.zeros(size, bool)
             ok[:n] = parsed.wellformed
             ship = self._kernels.ship
-            px, py, pz, valid = jax.device_get(self._kernels.g2_validate(
-                ship(x), ship(sgn), ship(inf), ship(ok)))
+            px, py, pz, valid = self._watched(
+                jax.device_get,
+                self._kernels.g2_validate(ship(x), ship(sgn),
+                                          ship(inf), ship(ok)),
+                size=size, path="update_pubkeys")
             aff = dev.g2_to_oracle(Point(jnp.asarray(px[:n]),
                                          jnp.asarray(py[:n]),
                                          jnp.asarray(pz[:n])))
@@ -1516,7 +1801,7 @@ class TpuBlsCrypto:
             self._device_failed("update_pubkeys", e)
             self._update_pubkeys_host(voters)
             return
-        self.breaker.record_success()
+        self._device_succeeded()
         self._append_pk_rows(voters, px[:n], py[:n], pz[:n], aff, valid)
 
     def _append_pk_rows(self, voters: List[bytes], px, py, pz,

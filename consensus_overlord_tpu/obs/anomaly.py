@@ -21,6 +21,9 @@ an alert:
   straggler_persistence — a StragglerDetector keeps flagging across
                           samples (one flag is noise; flags in most
                           recent samples is a sick chip)
+  ladder_step_down      — the MeshSupervisor degraded the dispatch
+                          rung (parallel/supervisor.py; raised by the
+                          supervisor itself, not a z-score detector)
 
 Each alert: one `alert` flightrec event, `obs_alerts_total{kind}`, and
 a bounded ring served as the /statusz "alerts" section.  `alert_count`
@@ -45,7 +48,7 @@ __all__ = ["ALERT_KINDS", "AnomalyDetector", "EwmaSeries"]
 
 #: The alert taxonomy (the obs_alerts_total{kind} label set).
 ALERT_KINDS = ("occupancy_collapse", "stage_time_spike", "shed_storm",
-               "straggler_persistence")
+               "straggler_persistence", "ladder_step_down")
 
 
 class EwmaSeries:

@@ -268,8 +268,22 @@ class Metrics:
             "obs_alerts_total",
             "Anomaly alerts raised over the telemetry series, by kind "
             "(occupancy_collapse / stage_time_spike / shed_storm / "
-            "straggler_persistence — obs/anomaly.py)",
+            "straggler_persistence / ladder_step_down — obs/anomaly.py)",
             ["kind"], registry=self.registry)
+
+        # -- mesh resilience (parallel/supervisor.py) ---------------------
+        self.mesh_ladder_transitions = Counter(
+            "mesh_ladder_transitions_total",
+            "MeshSupervisor escalation-ladder transitions (full_mesh / "
+            "sub_mesh / single_chip / host_oracle), by edge and reason "
+            "(the failing path + exception type on the way down, "
+            "'probe' on the way back up)",
+            ["from", "to", "reason"], registry=self.registry)
+        self.mesh_quarantined_devices = Gauge(
+            "mesh_quarantined_devices",
+            "Mesh lanes currently quarantined by the supervisor "
+            "(excluded from the rebuilt sub-mesh kernel set)",
+            registry=self.registry)
 
         # -- engine (engine/smr.py) ---------------------------------------
         self.round_duration_ms = Histogram(

@@ -89,6 +89,22 @@ class ConsensusConfig:
     #: window tables rebuilt on reconfigure (ops/curve.py
     #: msm_table_build; ~240 KB HBM per cached pubkey row).
     g2_table_msm: bool = False
+    #: Dispatch watchdog (crypto/tpu_provider.py): deadline in seconds
+    #: for each blocking device call, scaled up by batch rung — a
+    #: wedged collective becomes a DispatchTimeout breaker failure with
+    #: an exact host re-verify instead of blocking the frontier worker
+    #: forever.  <= 0 disables the watchdog (pre-r18 unbounded waits).
+    dispatch_deadline_s: float = 30.0
+    #: Mesh supervisor (parallel/supervisor.py): consecutive device
+    #: failures before the escalation ladder steps down one rung
+    #: (full mesh -> survivor sub-mesh -> single chip -> host oracle).
+    supervisor_step_threshold: int = 3
+    #: Consecutive clean dispatches (past the cooldown dwell) before the
+    #: supervisor probes one rung back up.
+    supervisor_probe_successes: int = 8
+    #: Minimum dwell after a step-down before any promotion probe; also
+    #: the host_oracle rung's probe-dispatch cadence.
+    supervisor_probe_cooldown_s: float = 5.0
     #: Engine flight recorder (obs/flightrec.py): ring capacity in
     #: events; 0 disables recording entirely.
     flight_recorder_capacity: int = 512
@@ -195,6 +211,19 @@ class ConsensusConfig:
                 f"mesh must be off|local|global, got {self.mesh!r} (a "
                 "typo here would silently fall back to the single-chip "
                 "kernel set)")
+        if self.supervisor_step_threshold < 1:
+            raise ValueError(
+                f"supervisor_step_threshold must be >= 1, got "
+                f"{self.supervisor_step_threshold} — the ladder would "
+                "step down on every single failure or never")
+        if self.supervisor_probe_successes < 1:
+            raise ValueError(
+                f"supervisor_probe_successes must be >= 1, got "
+                f"{self.supervisor_probe_successes}")
+        if self.supervisor_probe_cooldown_s < 0:
+            raise ValueError(
+                f"supervisor_probe_cooldown_s must be >= 0, got "
+                f"{self.supervisor_probe_cooldown_s}")
         if 0 < self.straggler_ratio < 1:
             raise ValueError(
                 f"straggler_ratio must be >= 1 (or <= 0 to disable), "

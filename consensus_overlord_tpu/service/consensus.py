@@ -62,7 +62,8 @@ def _make_crypto(backend: str, private_key: int,
             private_key,
             mesh=_make_mesh(config.mesh),
             device_pairing=config.device_pairing_flag,
-            g2_table_msm=config.g2_table_msm)
+            g2_table_msm=config.g2_table_msm,
+            dispatch_deadline_s=config.dispatch_deadline_s)
     if backend == "cpu":
         from ..crypto.provider import CpuBlsCrypto
         return CpuBlsCrypto(private_key)
@@ -146,6 +147,23 @@ class Consensus:
         breaker = getattr(self.crypto, "breaker", None)
         if breaker is not None and recorder is not None:
             breaker.recorder = recorder
+        # Mesh supervisor (parallel/supervisor.py): attached to any
+        # provider that can host one, it walks the escalation ladder
+        # (full mesh -> survivor sub-mesh -> single chip -> host
+        # oracle) from breaker cycles; service/main.py wires the
+        # straggler/anomaly detectors onto it once those exist, and
+        # serves it as the /statusz "ladder" section.
+        self.supervisor = None
+        attach_sup = getattr(self.crypto, "attach_supervisor", None)
+        if attach_sup is not None:
+            from ..parallel.supervisor import MeshSupervisor
+
+            self.supervisor = MeshSupervisor(
+                self.crypto, metrics=metrics, recorder=recorder,
+                step_threshold=config.supervisor_step_threshold,
+                probe_successes=config.supervisor_probe_successes,
+                probe_cooldown_s=config.supervisor_probe_cooldown_s)
+            attach_sup(self.supervisor)
         # tracer: the engine emits height/round/QC-verify spans through the
         # same exporter the gRPC layer uses (reference #[instrument]
         # coverage, src/consensus.rs:96,143,209).

@@ -159,6 +159,16 @@ class ServiceRuntime:
                 metrics=self.metrics, recorder=self.recorder,
                 straggler=self.straggler)
             self.metrics.add_status_source("alerts", self.anomaly.statusz)
+            # Mesh resilience (parallel/supervisor.py): feed the ladder
+            # the fleet signals — straggler flags attribute a timeout to
+            # a lane for quarantine, anomaly alerts carry step-downs —
+            # and serve it as the /statusz "ladder" section.
+            supervisor = self.consensus.supervisor
+            if supervisor is not None:
+                supervisor.straggler = self.straggler
+                supervisor.anomaly = self.anomaly
+                self.metrics.add_status_source(
+                    "ladder", supervisor.statusz)
         # Soak telemetry: periodic drift snapshots (WAL size, ring
         # churn, RSS, compile-cache ratio, breaker state) into a
         # bounded window; /statusz "trend" serves the deltas so an

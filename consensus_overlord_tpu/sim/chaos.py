@@ -52,6 +52,17 @@ fires every event whose height has been reached:
                admission path sheds to the host oracle — the
                shed-to-host-oracle survival story under a wedged
                shared chip
+  device_loss  a mesh lane of the target node's crypto provider is
+               lost for `duration_s` (`inject_device_loss`):
+               dispatches touching the lane raise DeviceLossError
+               until the MeshSupervisor quarantines it and rebuilds a
+               survivor sub-mesh — the self-healing ladder walk
+               (parallel/supervisor.py), down AND back up, in-run
+  dcn_stall    the provider's device calls wedge inside their dispatch
+               window for `duration_s` (`inject_dcn_stall`): the
+               dispatch watchdog converts the wedge to DispatchTimeout
+               breaker failures within dispatch_deadline_s — bounded
+               latency instead of a liveness hole
 
 The f-bound invariant: the runner never lets crashed + Byzantine nodes
 (`byzantine` OR `adaptive` windows) exceed f = ⌊(n−1)/3⌋ concurrently
@@ -106,13 +117,16 @@ class ChaosEvent:
     kind: str               # "crash" | "stall" | "error" | "partition"
     #                       # | "byzantine" | "device_fault" | "adaptive"
     #                       # | "tenant_flood" | "tenant_stall"
-    node: int = -1          # crash/device_fault/tenant_flood: validator
-    #                       # index; byzantine/adaptive: -1 = runner
-    #                       # picks an upcoming leader at fire time
+    #                       # | "device_loss" | "dcn_stall"
+    node: int = -1          # crash/device_fault/tenant_flood/device_loss/
+    #                       # dcn_stall: validator index; byzantine/
+    #                       # adaptive: -1 = runner picks an upcoming
+    #                       # leader at fire time
     duration_s: float = 0.5  # downtime / fault / flood / stall window
     behavior: str = ""      # byzantine/adaptive: adversary behavior name
     heights: int = 0        # byzantine/adaptive: window length in heights
     defers: int = 0         # times the runner pushed it back (f-bound)
+    device: int = -1        # device_loss: mesh lane index to lose
 
 
 @dataclass
@@ -129,7 +143,10 @@ class ChaosSchedule:
                  device_window_s: float = 0.6,
                  adaptive: int = 0, tenant_floods: int = 0,
                  tenant_stalls: int = 0,
-                 tenant_window_s: float = 0.8) -> "ChaosSchedule":
+                 tenant_window_s: float = 0.8,
+                 device_losses: int = 0, dcn_stalls: int = 0,
+                 mesh_lanes: int = 8,
+                 mesh_window_s: float = 0.8) -> "ChaosSchedule":
         """Derive a schedule from one seeded RNG.  Events land on
         distinct heights in [2, heights-1] — height 1 establishes the
         fleet, and the last height is post-fault runway proving
@@ -149,6 +166,10 @@ class ChaosSchedule:
         (its own event kind, same budget/window/target machinery).
         tenant_floods / tenant_stalls: SharedFrontier attack windows
         (no-ops, logged, when the fleet has no shared frontier).
+        device_losses / dcn_stalls: mesh-resilience windows
+        (inject_device_loss / inject_dcn_stall; no-ops, logged, when
+        the target crypto has no mesh chaos hooks).  device_loss lanes
+        draw from range(mesh_lanes); both use mesh_window_s.
 
         The RNG draw order is append-only: a schedule generated with
         byzantine=0 and device_faults=0 is bit-identical to one from
@@ -216,6 +237,16 @@ class ChaosSchedule:
         for _ in range(tenant_stalls):
             events.append(ChaosEvent(rng.choice(span), "tenant_stall",
                                      duration_s=tenant_window_s))
+        for _ in range(device_losses):
+            events.append(ChaosEvent(rng.choice(span), "device_loss",
+                                     node=rng.randrange(n_validators),
+                                     duration_s=mesh_window_s,
+                                     device=rng.randrange(
+                                         max(int(mesh_lanes), 1))))
+        for _ in range(dcn_stalls):
+            events.append(ChaosEvent(rng.choice(span), "dcn_stall",
+                                     node=rng.randrange(n_validators),
+                                     duration_s=mesh_window_s))
         return cls(events)
 
     def shift(self, delta: int) -> "ChaosSchedule":
@@ -264,6 +295,13 @@ class ChaosRunner:
         self.tenant_floods: List[dict] = []
         #: tenant_stall windows fired.
         self.tenant_stalls: List[dict] = []
+        #: device_loss / dcn_stall windows fired (mesh resilience).
+        self.device_losses: List[dict] = []
+        self.dcn_stalls: List[dict] = []
+        #: MeshSupervisors touched by mesh chaos (drain waits for their
+        #: ladders to climb back to the top rung so the down-AND-up
+        #: cycle completes in-run).
+        self._supervisors: List = []
         net.controller.on_new_height.append(self._on_height)
 
     def detach(self) -> None:
@@ -436,6 +474,10 @@ class ChaosRunner:
                 await self._tenant_flood(ev, entry)
             elif ev.kind == "tenant_stall":
                 self._tenant_stall(ev, entry)
+            elif ev.kind == "device_loss":
+                self._device_loss(ev, entry)
+            elif ev.kind == "dcn_stall":
+                self._dcn_stall(ev, entry)
             else:
                 logger.warning("chaos: unknown event kind %r", ev.kind)
         except Exception:  # noqa: BLE001 — chaos must not crash the run
@@ -605,6 +647,64 @@ class ChaosRunner:
         entry.update(stats)
         self.tenant_stalls.append(stats)
 
+    # -- mesh-resilience events (device_loss / dcn_stall) ------------------
+
+    def _mesh_provider(self, node_idx: int, hook: str):
+        """The crypto provider whose mesh the event attacks: the shared
+        frontier's provider when the fleet rides one (per-node cryptos
+        only sign there — same targeting as device_fault), else the
+        node's own.  None (logged) when it lacks the chaos hook."""
+        provider = self.net.nodes[node_idx].crypto
+        core = getattr(self.net, "shared_frontier", None)
+        if core is not None:
+            shared = getattr(core, "_provider", None)
+            if shared is not None and hasattr(shared, hook):
+                provider = shared
+        if not hasattr(provider, hook):
+            logger.warning("chaos: node %d crypto has no %s; "
+                           "mesh event skipped", node_idx, hook)
+            return None
+        sup = getattr(provider, "_supervisor", None)
+        if sup is not None and sup not in self._supervisors:
+            self._supervisors.append(sup)
+        return provider
+
+    def _device_loss(self, ev: ChaosEvent, entry: dict) -> None:
+        """Lose one mesh lane for the window: dispatches touching it
+        raise DeviceLossError until the supervisor quarantines the lane
+        and rebuilds a survivor sub-mesh — after which the window is
+        still live but dispatch runs clean (the self-healing proof)."""
+        provider = self._mesh_provider(ev.node, "inject_device_loss")
+        if provider is None:
+            return
+        provider.inject_device_loss(ev.device, ev.duration_s)
+        node = self.net.nodes[ev.node]
+        if node.recorder is not None:
+            node.recorder.record("chaos_device_loss", node=ev.node,
+                                 device=ev.device,
+                                 duration_s=ev.duration_s)
+        stats = {"node": ev.node, "device": ev.device,
+                 "duration_s": ev.duration_s}
+        entry.update(stats)
+        self.device_losses.append(stats)
+
+    def _dcn_stall(self, ev: ChaosEvent, entry: dict) -> None:
+        """Wedge the provider's device calls inside their dispatch
+        window: the watchdog converts the wedge to DispatchTimeout
+        breaker failures within dispatch_deadline_s, and the ladder
+        steps down — bounded latency, never a liveness hole."""
+        provider = self._mesh_provider(ev.node, "inject_dcn_stall")
+        if provider is None:
+            return
+        provider.inject_dcn_stall(ev.duration_s)
+        node = self.net.nodes[ev.node]
+        if node.recorder is not None:
+            node.recorder.record("chaos_dcn_stall", node=ev.node,
+                                 duration_s=ev.duration_s)
+        stats = {"node": ev.node, "duration_s": ev.duration_s}
+        entry.update(stats)
+        self.dcn_stalls.append(stats)
+
     # -- teardown ----------------------------------------------------------
 
     async def drain(self, timeout: float = 10.0) -> None:
@@ -626,6 +726,7 @@ class ChaosRunner:
             self._disarm(idx)
         self._disarm_at.clear()
         await self._settle_breakers(timeout)
+        await self._settle_ladders(timeout)
 
     async def _settle_breakers(self, timeout: float) -> None:
         """Wait until every fault-injected breaker has run a genuine
@@ -663,6 +764,35 @@ class ChaosRunner:
             if b.fault_injected:
                 b.clear_injected_faults()
 
+    async def _settle_ladders(self, timeout: float) -> None:
+        """Wait until every supervisor a mesh event touched has climbed
+        back to the top rung — the down-AND-up half of the self-healing
+        contract (the fleet keeps committing during drain, so clean
+        dispatches keep arriving to probe the ladder up).  Best-effort:
+        a ladder stuck below the top at the deadline is logged; the
+        run's assertions decide whether that fails it."""
+        if not self._supervisors:
+            return
+
+        def recovered() -> bool:
+            return all(s.rung == "full_mesh" for s in self._supervisors)
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            if recovered():
+                return
+            await asyncio.sleep(0.05)
+        logger.warning("chaos: ladder(s) still below full_mesh after "
+                       "drain timeout: %s",
+                       [s.statusz()["rung"] for s in self._supervisors])
+
+    @property
+    def ladder_supervisors(self) -> List:
+        """Supervisors the mesh events touched (run assertions read
+        their transition history post-drain)."""
+        return list(self._supervisors)
+
     @property
     def device_faults_effective(self) -> int:
         """Fault-injected breakers whose window actually bit (at least
@@ -687,6 +817,10 @@ class ChaosRunner:
             "device_faults_effective": self.device_faults_effective,
             "tenant_floods": self.tenant_floods,
             "tenant_stalls": self.tenant_stalls,
+            "device_losses": self.device_losses,
+            "dcn_stalls": self.dcn_stalls,
+            "ladder_transitions": [t for s in self._supervisors
+                                   for t in s.statusz()["recent"]],
             # Device-batch throughput while each adversary window was
             # armed: disarm-time minus arm-time batch counts (None =
             # window still open — drain() closes them all).

@@ -124,6 +124,28 @@ def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
         assert flood["rejected"] > 0, (
             f"tenant_flood on {flood['tenant']} sent {flood['sent']} "
             f"invalid verifies but none were rejected")
+    # Mesh chaos: every ladder a device_loss/dcn_stall window actually
+    # drove must have RECOVERED — final rung back at full_mesh, with a
+    # step-down and a probe step-up in its history (the down-AND-up
+    # self-healing cycle).  A window no device call ever hit (sub-
+    # threshold path) fires no transition; warn, don't fail.
+    if summary.get("device_losses") or summary.get("dcn_stalls"):
+        walked = [s for s in chaos.ladder_supervisors
+                  if s.statusz()["transitions"]]
+        if not walked:
+            print("warning: mesh chaos window(s) armed but no ladder "
+                  "transition fired (no device call hit the window?)",
+                  file=sys.stderr)
+        for sup in walked:
+            st = sup.statusz()
+            assert st["rung"] == "full_mesh", (
+                f"mesh ladder stuck at {st['rung']!r} after drain "
+                f"(quarantined={st['quarantined']}): {st['recent']}")
+            downs = [t for t in st["recent"] if t["reason"] != "probe"]
+            ups = [t for t in st["recent"] if t["reason"] == "probe"]
+            assert downs and ups, (
+                f"mesh chaos fired but the ladder history shows no "
+                f"down-and-up cycle: {st['recent']}")
     if summary["device_faults_fired"]:
         if chaos.device_faults_effective == 0:
             # The window never bit: this crypto path made no device
@@ -141,6 +163,17 @@ def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
             assert count > 0, (
                 f"device faults fired but no breaker transition to "
                 f"{to!r} recorded")
+        # The transition counters above prove the cycle happened at some
+        # point; a breaker left stuck OPEN at run end — recovery that
+        # never completed — must fail the run too.  Only windows that
+        # actually bit are held to it (an idle window leaves its breaker
+        # closed trivially, and _settle_breakers already cleared
+        # leftovers).
+        for b, _, injected0 in chaos._breakers:
+            if b.total_injected > injected0:
+                assert b.state == "closed", (
+                    f"device_fault breaker finished {b.state!r}, not "
+                    f"re-closed: {b.status()}")
 
 
 def main() -> None:
@@ -219,6 +252,23 @@ def main() -> None:
                         "run then also asserts a full "
                         "open/half_open/closed transition cycle in "
                         "metrics")
+    parser.add_argument("--chaos-device-losses", type=int, default=0,
+                        help="device_loss events: a mesh lane of the "
+                        "target node's crypto is lost for the window "
+                        "(dispatches raise DeviceLossError) until the "
+                        "MeshSupervisor quarantines it and rebuilds a "
+                        "survivor sub-mesh — the self-healing ladder "
+                        "walk, down and back up, inside the schedule")
+    parser.add_argument("--chaos-dcn-stalls", type=int, default=0,
+                        help="dcn_stall events: the target crypto's "
+                        "device calls wedge inside their dispatch "
+                        "window; the dispatch watchdog converts the "
+                        "wedge to DispatchTimeout breaker failures "
+                        "within the deadline — bounded latency, never "
+                        "a liveness hole")
+    parser.add_argument("--chaos-mesh-window-ms", type=float,
+                        default=800.0,
+                        help="device_loss / dcn_stall window length")
     parser.add_argument("--chaos-byz-window", type=int, default=None,
                         help="heights an adversary stays armed "
                         "(default: max(2, --validators), so "
@@ -270,6 +320,13 @@ def main() -> None:
                         "default so chaos floods engage admission "
                         "control at CI length")
     parser.add_argument("--frontier-linger-ms", type=float, default=2.0)
+    parser.add_argument("--dispatch-deadline-s", type=float, default=None,
+                        help="watchdog deadline for each blocking device "
+                        "call on --tpu bls providers (rung-scaled; a "
+                        "wedged collective becomes a DispatchTimeout "
+                        "breaker failure with exact host re-verify).  "
+                        "Default: CONSENSUS_DISPATCH_DEADLINE_S, else "
+                        "off")
     parser.add_argument("--device-threshold", type=int, default=8,
                         help="batch size at which --tpu providers ship "
                         "work to the device instead of the host oracle "
@@ -396,10 +453,13 @@ def main() -> None:
     n_byzantine = (len(explicit_behaviors) if explicit_behaviors
                    else args.chaos_byzantine)
     n_tenant_events = args.chaos_tenant_floods + args.chaos_tenant_stalls
+    n_mesh_events = args.chaos_device_losses + args.chaos_dcn_stalls
     if (n_byzantine or args.chaos_device_faults or args.chaos_adaptive
-            or n_tenant_events) and not args.chaos:
+            or n_tenant_events or n_mesh_events) and not args.chaos:
         parser.error("--chaos-byzantine / --chaos-device-faults / "
-                     "--chaos-adaptive / --chaos-tenant-* need --chaos")
+                     "--chaos-adaptive / --chaos-tenant-* / "
+                     "--chaos-device-losses / --chaos-dcn-stalls "
+                     "need --chaos")
     if args.soak_chaos and not (args.chaos and args.soak_seconds > 0):
         parser.error("--soak-chaos needs --chaos and --soak-seconds")
     # Tenant chaos attacks the multi-tenant core; a fleet that doesn't
@@ -423,7 +483,8 @@ def main() -> None:
             # small fleets, keeping the reported "tpu" field truthful
             factory = lambda i: TpuBlsCrypto(  # noqa: E731
                 0x1000 + 7919 * i,
-                device_threshold=args.device_threshold)
+                device_threshold=args.device_threshold,
+                dispatch_deadline_s=args.dispatch_deadline_s)
         else:
             from ..crypto.provider import CpuBlsCrypto
 
@@ -582,6 +643,33 @@ def main() -> None:
                                   straggler=straggler)
         sampler.add_observer(anomaly.observe_sample)
         fleet = FleetAggregator("sim", sampler.trend)
+        # Mesh resilience (parallel/supervisor.py): attach an escalation-
+        # ladder supervisor to every provider that can host one when the
+        # schedule carries mesh events.  Sim providers walk the ladder
+        # as bookkeeping (no kernel sets to swap); --tpu bls providers
+        # really rebuild sub-mesh kernels.  Fast probe cadence: sim
+        # chains commit every tens of ms, so the down-AND-up cycle must
+        # complete inside a CI-length run.
+        supervisors = []
+        if n_mesh_events:
+            from ..parallel.supervisor import MeshSupervisor
+
+            def _attach_supervisor(provider):
+                if not hasattr(provider, "attach_supervisor"):
+                    return
+                sup = MeshSupervisor(provider, metrics=metrics,
+                                     recorder=event_recorder,
+                                     straggler=straggler, anomaly=anomaly,
+                                     step_threshold=3, probe_successes=4,
+                                     probe_cooldown_s=0.2)
+                provider.attach_supervisor(sup)
+                supervisors.append(sup)
+
+            if shared_core is not None:
+                _attach_supervisor(shared_provider)
+            else:
+                for n in net.nodes:
+                    _attach_supervisor(n.crypto)
         statusz_port = None
         if args.statusz_port is not None:
             # The fleet shares one registry; statusz reports node 0's
@@ -612,6 +700,11 @@ def main() -> None:
                 metrics.add_status_source("mesh", straggler.statusz)
             metrics.add_status_source("alerts", anomaly.statusz)
             metrics.add_status_source("fleet", fleet.statusz)
+            # Escalation-ladder state (rung, quarantine, transition
+            # history) — the first supervisor is the one mesh chaos
+            # targets (the shared core's, or node 0's).
+            if supervisors:
+                metrics.add_status_source("ladder", supervisors[0].statusz)
             metrics.add_debug_handler(
                 "/debug/profile",
                 lambda q: session.request(int(q.get("rounds", "1"))))
@@ -647,7 +740,10 @@ def main() -> None:
                 adaptive=args.chaos_adaptive,
                 tenant_floods=args.chaos_tenant_floods,
                 tenant_stalls=args.chaos_tenant_stalls,
-                tenant_window_s=args.chaos_tenant_window_ms / 1000.0)
+                tenant_window_s=args.chaos_tenant_window_ms / 1000.0,
+                device_losses=args.chaos_device_losses,
+                dcn_stalls=args.chaos_dcn_stalls,
+                mesh_window_s=args.chaos_mesh_window_ms / 1000.0)
 
         if args.chaos:
             from .chaos import ChaosRunner
@@ -660,8 +756,12 @@ def main() -> None:
                     detail = f" (node {ev.node})"
                 elif ev.kind in ("byzantine", "adaptive"):
                     detail = f" ({ev.behavior}, {ev.heights} heights)"
-                elif ev.kind in ("device_fault", "tenant_flood"):
+                elif ev.kind in ("device_fault", "tenant_flood",
+                                 "dcn_stall"):
                     detail = f" (node {ev.node}, {ev.duration_s:.1f}s)"
+                elif ev.kind == "device_loss":
+                    detail = (f" (node {ev.node}, lane {ev.device}, "
+                              f"{ev.duration_s:.1f}s)")
                 elif ev.kind == "tenant_stall":
                     detail = f" ({ev.duration_s:.1f}s)"
                 print(f"chaos: {ev.kind} armed at height {ev.at_height}"
@@ -792,6 +892,8 @@ def main() -> None:
                             "behaviors_active": s["behaviors_active"],
                             "tenant_floods": s["tenant_floods"],
                             "tenant_stalls": len(s["tenant_stalls"]),
+                            "device_losses": len(s["device_losses"]),
+                            "dcn_stalls": len(s["dcn_stalls"]),
                         })
                 else:
                     while time.perf_counter() < soak_deadline:
@@ -900,6 +1002,12 @@ def main() -> None:
         }
         if straggler is not None:
             out["mesh"] = straggler.statusz()
+        if supervisors:
+            # Escalation-ladder disposition (summary-side twin of the
+            # /statusz "ladder" section): the nightly mesh-resilience
+            # lane asserts its down-and-up transition history here.
+            out["ladder"] = {"supervisors": [s.statusz()
+                                             for s in supervisors]}
         if chaos is not None:
             out["chaos"] = {
                 "seed": chaos_seed,

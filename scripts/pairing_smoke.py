@@ -16,9 +16,16 @@ the D Fq12 partials all-gather, and every device finishes the identical
 product + final exponentiation — the verdict must still be bit-identical
 to the host oracle.  Pairs pad up to the mesh size with masked lanes.
 
+--inject-loss LANE (mesh mode only) additionally exercises one
+self-healing ladder step end-to-end through the production provider
+(crypto/tpu_provider.py + parallel/supervisor.py): warm a full-mesh
+verify, lose lane LANE mid-run, and require that the supervisor
+quarantines exactly that lane, rebuilds a (D-1)-lane sub-mesh, and the
+sub-mesh verdicts stay bit-identical to the host oracle.
+
 Exit 0 on full agreement, 1 with a per-set report otherwise.
 
-Usage: python scripts/pairing_smoke.py [N] [--mesh D]
+Usage: python scripts/pairing_smoke.py [N] [--mesh D] [--inject-loss LANE]
 """
 
 import os
@@ -27,8 +34,16 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-_args = [a for a in sys.argv[1:] if not a.startswith("-")]
+_flag_vals = set()
+for _f in ("--mesh", "--inject-loss"):
+    if _f in sys.argv:
+        _flag_vals.add(sys.argv.index(_f) + 1)
+_args = [a for i, a in enumerate(sys.argv[1:], start=1)
+         if not a.startswith("-") and i not in _flag_vals]
 N = int(_args[0]) if _args else 4
+INJECT_LOSS = -1
+if "--inject-loss" in sys.argv:
+    INJECT_LOSS = int(sys.argv[sys.argv.index("--inject-loss") + 1])
 MESH = 0
 if "--mesh" in sys.argv:
     MESH = int(sys.argv[sys.argv.index("--mesh") + 1])
@@ -72,6 +87,71 @@ def _verdict_fn():
     return sharded_multi_pairing_is_one(mesh)
 
 
+def _ladder_smoke() -> int:
+    """--inject-loss LANE: one self-healing ladder step, end to end.
+
+    full_mesh verify (warm) -> inject_device_loss(LANE) -> the loss
+    surfaces as a DeviceLossError, the verdicts come from the exact host
+    fallback, the supervisor quarantines the named lane and rebuilds a
+    (D-1)-lane sub-mesh -> the sub-mesh dispatch runs clean while the
+    lane is still lost, verdicts bit-identical to the host oracle.
+    """
+    from consensus_overlord_tpu.core.sm3 import sm3_hash
+    from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+    from consensus_overlord_tpu.parallel import make_mesh
+    from consensus_overlord_tpu.parallel.supervisor import MeshSupervisor
+
+    provider = TpuBlsCrypto(0xD1CE, device_threshold=1,
+                            mesh=make_mesh(MESH))
+    # One failure steps down; the huge probe budget + dwell keep the
+    # ladder parked on sub_mesh for the rest of the smoke.
+    sup = MeshSupervisor(provider, step_threshold=1,
+                         probe_successes=10_000, probe_cooldown_s=3600.0)
+    provider.attach_supervisor(sup)
+
+    batch = 2 * MESH
+    h = sm3_hash(b"ladder-smoke-block")
+    sks = [9000 + 17 * i for i in range(batch)]
+    sigs = [oracle.sign(sk, h) for sk in sks]
+    pks = [oracle.sk_to_pk(sk) for sk in sks]
+    provider.update_pubkeys(pks)
+    expect = [i != 3 for i in range(batch)]  # one forged lane, like main()
+    sigs[3] = oracle.sign(sks[3], sm3_hash(b"other message"))
+
+    got = provider.verify_batch(sigs, [h] * batch, pks)
+    if got != expect or sup.rung != "full_mesh":
+        print(f"FAIL: full-mesh verdicts {got} (rung={sup.rung})")
+        return 1
+    print(f"full_mesh: {batch}-sig verdicts identical to the host oracle",
+          flush=True)
+
+    lane = provider.mesh_device_names()[INJECT_LOSS]
+    provider.inject_device_loss(lane, seconds=3600.0)
+    got = provider.verify_batch(sigs, [h] * batch, pks)
+    if got != expect:
+        print(f"FAIL: host-fallback verdicts wrong under loss: {got}")
+        return 1
+    if sup.rung != "sub_mesh" or sup.quarantined_devices() != [lane]:
+        print(f"FAIL: wanted sub_mesh quarantining [{lane}], got "
+              f"rung={sup.rung} quarantined={sup.quarantined_devices()}")
+        return 1
+    print(f"lane {lane} lost: exact host fallback, supervisor stepped "
+          f"full_mesh -> sub_mesh ({MESH - 1} lanes)", flush=True)
+
+    fallbacks0 = provider.breaker.total_fallbacks
+    got = provider.verify_batch(sigs, [h] * batch, pks)
+    if got != expect:
+        print(f"FAIL: sub-mesh verdicts wrong: {got}")
+        return 1
+    if provider.breaker.total_fallbacks != fallbacks0:
+        print("FAIL: sub-mesh pass fell back to the host "
+              "(the rebuilt kernels should dispatch clean)")
+        return 1
+    print(f"ok: sub-mesh verdicts identical to the host oracle with "
+          f"lane {lane} still lost")
+    return 0
+
+
 def main() -> int:
     neg_g2 = (oracle.G2_GEN[0], oracle.fq2_neg(oracle.G2_GEN[1]))
     verdict = _verdict_fn()
@@ -108,6 +188,11 @@ def main() -> int:
         print(f"FAIL: {failures}/{N} sets disagree")
         return 1
     print(f"ok: {N}/{N} {kind} verdicts identical to the host oracle")
+    if INJECT_LOSS >= 0:
+        if not MESH:
+            print("FAIL: --inject-loss needs --mesh D")
+            return 1
+        return _ladder_smoke()
     return 0
 
 

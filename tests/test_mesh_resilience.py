@@ -1,0 +1,441 @@
+"""Self-healing mesh (ISSUE 18): the dispatch watchdog, the
+MeshSupervisor escalation ladder, and device-loss/DCN-stall chaos.
+
+The acceptance surface: a wedged dispatch becomes a DispatchTimeout
+breaker failure within the rung-scaled deadline + epsilon (never a hung
+fleet); a lost lane is quarantined and the provider rebuilds a survivor
+sub-mesh whose verdicts stay bit-identical to the host oracle; the
+ladder walks back up once the fault clears; and a seeded chaos schedule
+with device_loss + dcn_stall events commits with zero violations.
+
+The standing guarantee under test at every rung: verdicts are exact —
+degradation costs throughput, never correctness or liveness.
+"""
+
+import time
+
+import pytest
+
+from consensus_overlord_tpu.core.sm3 import sm3_hash
+from consensus_overlord_tpu.crypto import bls12381 as oracle
+from consensus_overlord_tpu.parallel.supervisor import RUNGS, MeshSupervisor
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# MeshSupervisor ladder logic (no hardware, stub provider)
+# ---------------------------------------------------------------------------
+
+class StubProvider:
+    """Duck-typed provider: records apply_mesh_rung calls."""
+
+    def __init__(self, lanes=8, fail_rungs=()):
+        self._lanes = lanes
+        self.fail_rungs = set(fail_rungs)
+        self.applied = []
+
+    def mesh_device_names(self):
+        return [f"sim:{i}" for i in range(self._lanes)]
+
+    def apply_mesh_rung(self, rung, quarantined):
+        if rung in self.fail_rungs:
+            raise RuntimeError(f"rebuild of {rung} failed")
+        self.applied.append((rung, tuple(quarantined)))
+
+
+class TestSupervisorLadder:
+    def _sup(self, provider=None, **kw):
+        clock = FakeClock()
+        kw.setdefault("step_threshold", 2)
+        kw.setdefault("probe_successes", 2)
+        kw.setdefault("probe_cooldown_s", 5.0)
+        sup = MeshSupervisor(provider or StubProvider(), clock=clock, **kw)
+        return sup, clock
+
+    def test_rung_order(self):
+        assert RUNGS == ("full_mesh", "sub_mesh", "single_chip",
+                         "host_oracle")
+
+    def test_attributed_loss_quarantines_and_rebuilds_sub_mesh(self):
+        from consensus_overlord_tpu.crypto.breaker import DeviceLossError
+
+        provider = StubProvider()
+        sup, _ = self._sup(provider)
+        e = DeviceLossError("sim:5")
+        sup.record_failure("verify_batch", e)
+        assert sup.rung == "full_mesh"  # below threshold
+        sup.record_failure("verify_batch", e)
+        assert sup.rung == "sub_mesh"
+        assert sup.quarantined_devices() == ["sim:5"]
+        assert provider.applied == [("sub_mesh", ("sim:5",))]
+
+    def test_success_resets_the_failure_streak(self):
+        from consensus_overlord_tpu.crypto.breaker import DeviceLossError
+
+        sup, _ = self._sup()
+        sup.record_failure("verify_batch", DeviceLossError("sim:1"))
+        sup.record_success()
+        sup.record_failure("verify_batch", DeviceLossError("sim:1"))
+        assert sup.rung == "full_mesh"  # streak broken: never 2 in a row
+
+    def test_unattributed_failure_falls_to_single_chip(self):
+        provider = StubProvider()
+        sup, _ = self._sup(provider)
+        for _ in range(2):
+            sup.record_failure("aggregate", RuntimeError("wedged"))
+        assert sup.rung == "single_chip"
+        assert sup.quarantined_devices() == []
+
+    def test_straggler_attribution_names_the_lane(self):
+        class Straggler:
+            @staticmethod
+            def flagged_devices():
+                return ["sim:3"]
+
+        provider = StubProvider()
+        sup, _ = self._sup(provider, straggler=Straggler())
+        for _ in range(2):
+            sup.record_failure("verify_batch", RuntimeError("slow"))
+        assert sup.rung == "sub_mesh"
+        assert sup.quarantined_devices() == ["sim:3"]
+
+    def test_full_down_and_up_walk(self):
+        from consensus_overlord_tpu.crypto.breaker import DeviceLossError
+
+        provider = StubProvider()
+        sup, clock = self._sup(provider)
+
+        def down(exc):
+            for _ in range(2):
+                sup.record_failure("verify_batch", exc)
+
+        down(DeviceLossError("sim:5"))
+        assert sup.rung == "sub_mesh"
+        down(RuntimeError("wedged"))
+        assert sup.rung == "single_chip"
+        down(RuntimeError("wedged"))
+        assert sup.rung == "host_oracle"
+        down(RuntimeError("still dead"))
+        assert sup.rung == "host_oracle"  # bottom rung holds
+
+        # Probe successes inside the dwell window do NOT promote.
+        sup.record_success()
+        sup.record_success()
+        assert sup.rung == "host_oracle"
+        clock.t += 5.1
+        for want in ("single_chip", "sub_mesh", "full_mesh"):
+            sup.record_success()
+            sup.record_success()
+            assert sup.rung == want
+        # The climb back through sub_mesh kept the quarantine, and the
+        # final promotion probes the old lane with real traffic.
+        assert sup.quarantined_devices() == []
+        assert [r for r, _ in provider.applied] == [
+            "sub_mesh", "single_chip", "host_oracle", "single_chip",
+            "sub_mesh", "full_mesh"]
+        st = sup.statusz()
+        assert st["rung"] == "full_mesh"
+        assert st["transitions"] == 6
+        assert [t["reason"] for t in st["recent"][-3:]] == ["probe"] * 3
+
+    def test_host_oracle_lets_one_probe_per_cooldown(self):
+        sup, clock = self._sup()
+        for _ in range(6):
+            sup.record_failure("verify_batch", RuntimeError("dead"))
+        assert sup.rung == "host_oracle"
+        assert sup.allow_device()       # the single half-open probe
+        assert not sup.allow_device()   # everyone else: host oracle
+        clock.t += 5.1
+        assert sup.allow_device()       # next probe window
+        # Above the bottom rung the gate is wide open.
+        sup2, _ = self._sup()
+        assert sup2.allow_device() and sup2.allow_device()
+
+    def test_failed_rebuild_degrades_further_instead_of_wedging(self):
+        from consensus_overlord_tpu.crypto.breaker import DeviceLossError
+
+        provider = StubProvider(fail_rungs={"sub_mesh"})
+        sup, _ = self._sup(provider)
+        for _ in range(2):
+            sup.record_failure("verify_batch", DeviceLossError("sim:2"))
+        assert sup.rung == "single_chip"
+        assert sup.statusz()["recent"][-1]["reason"].startswith(
+            "rebuild_failed")
+
+    def test_too_few_survivors_skips_the_sub_mesh_rung(self):
+        provider = StubProvider(lanes=2)
+        sup, _ = self._sup(provider)
+        from consensus_overlord_tpu.crypto.breaker import DeviceLossError
+
+        for _ in range(2):
+            sup.record_failure("verify_batch", DeviceLossError("sim:0"))
+        assert sup.rung == "single_chip"  # 1 survivor is not a mesh
+
+    def test_transitions_are_metered_and_recorded(self):
+        from consensus_overlord_tpu.crypto.breaker import DeviceLossError
+        from consensus_overlord_tpu.obs import Metrics, snapshot
+        from consensus_overlord_tpu.obs.flightrec import FlightRecorder
+
+        m = Metrics()
+        rec = FlightRecorder(capacity=16)
+        sup, _ = self._sup(StubProvider(), metrics=m, recorder=rec)
+        for _ in range(2):
+            sup.record_failure("verify_batch", DeviceLossError("sim:4"))
+        scraped = snapshot(m.registry)
+        assert scraped[
+            "mesh_ladder_transitions_total{from=full_mesh,"
+            "reason=verify_batch: DeviceLossError,to=sub_mesh}"] == 1.0
+        assert scraped["mesh_quarantined_devices"] == 1.0
+        kinds = [e["kind"] for e in rec.tail(16)]
+        assert "ladder_transition" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Dispatch watchdog (real provider, single chip)
+# ---------------------------------------------------------------------------
+
+N = 4
+KEYS = [0x7A31 * (i + 1) + 5 for i in range(N)]
+
+
+@pytest.fixture(scope="module")
+def signed_batch():
+    h = sm3_hash(b"watchdog-block")
+    sigs = [oracle.sign(k, h) for k in KEYS]
+    pks = [oracle.sk_to_pk(k) for k in KEYS]
+    return h, sigs, pks
+
+
+class TestDispatchWatchdog:
+    def test_deadline_scales_with_the_batch_rung(self):
+        from consensus_overlord_tpu.crypto.tpu_provider import (
+            _PAD_SIZES,
+            TpuBlsCrypto,
+        )
+
+        t = TpuBlsCrypto(0xBEEF, dispatch_deadline_s=2.0)
+        assert t._deadline_for(_PAD_SIZES[0]) == 2.0
+        assert t._deadline_for(4 * _PAD_SIZES[0]) == 4.0  # sqrt scaling
+        assert t._deadline_for(0) == 2.0  # floor at the base
+        off = TpuBlsCrypto(0xBEEF, dispatch_deadline_s=0.0)
+        assert off._deadline_for(8192) is None
+
+    @pytest.mark.slow  # real pairing kernels + host re-verify: nightly lane
+    def test_wedged_dispatch_times_out_with_exact_host_verdicts(
+            self, signed_batch):
+        """The r18 acceptance slice on one chip: a DCN stall longer than
+        the deadline surfaces as a DispatchTimeout breaker failure
+        within deadline + epsilon (not a 20 s hang), the batch
+        re-verifies exactly on the host oracle, and the breaker status
+        names the timeout."""
+        from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+
+        h, sigs, pks = signed_batch
+        # Warm with the watchdog off so compile time can't race the
+        # deadline, then arm it for the wedged dispatch.
+        t = TpuBlsCrypto(KEYS[0], device_threshold=1,
+                         qc_device_threshold=10**9,
+                         dispatch_deadline_s=0.0)
+        t.update_pubkeys(pks)
+        sigs = list(sigs)
+        sigs[1] = oracle.sign(KEYS[1], sm3_hash(b"forged"))
+        want = [i != 1 for i in range(N)]
+        assert t.verify_batch(sigs, [h] * N, pks) == want  # warm, device
+
+        from consensus_overlord_tpu.crypto.breaker import DispatchTimeout
+
+        t._dispatch_deadline_s = 1.5
+        t.inject_dcn_stall(30.0)
+        # The watchdog primitive itself: fires at the deadline, not at
+        # the end of the 30 s wedge.
+        t0 = time.monotonic()
+        with pytest.raises(DispatchTimeout):
+            t._watched(lambda: None, size=8, path="verify_batch")
+        cut = time.monotonic() - t0
+        assert 1.4 <= cut < 1.5 + 1.0, \
+            f"watchdog fired at {cut:.2f}s (deadline 1.5s)"
+        # End to end: the wedged batch re-verifies exactly on the host
+        # oracle (elapsed includes that re-verify, so the bound only
+        # proves the 30 s wedge was cut short, not ridden out).
+        t0 = time.monotonic()
+        got = t.verify_batch(sigs, [h] * N, pks)
+        elapsed = time.monotonic() - t0
+        t.inject_dcn_stall(0.0)
+        assert got == want                     # exact host re-verify
+        assert elapsed < 15.0, \
+            f"verify took {elapsed:.1f}s — rode out the wedge"
+        st = t.breaker.status()
+        assert "DispatchTimeout" in st["last_failure_reason"]
+        assert t.pairing_host_fallbacks == 0   # batch path, not pairing
+
+    def test_breaker_status_serves_cooldown_remaining(self):
+        from consensus_overlord_tpu.crypto.breaker import CircuitBreaker
+
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clock)
+        st = b.status()
+        assert st["last_failure_reason"] == ""
+        assert st["cooldown_remaining_s"] == 0.0
+        b.record_failure("verify_batch: DispatchTimeout")
+        clock.t += 2.0
+        st = b.status()
+        assert st["state"] == "open"
+        assert st["last_failure_reason"] == "verify_batch: DispatchTimeout"
+        assert st["cooldown_remaining_s"] == pytest.approx(3.0)
+        clock.t += 3.1
+        assert b.allow()  # half-open probe
+        b.record_success()
+        st = b.status()
+        assert st["cooldown_remaining_s"] == 0.0  # closed: no countdown
+        assert st["last_failure_reason"] != ""    # sticky: forensics
+
+
+# ---------------------------------------------------------------------------
+# Ladder walk on the 8-lane virtual mesh (real provider + kernels)
+# ---------------------------------------------------------------------------
+
+class TestMeshLadderEndToEnd:
+    @pytest.mark.slow  # compiles the 8- AND 7-lane mesh kernel sets and
+    # host-verifies 16-sig batches at every rung (~10 min on one core):
+    # the nightly slow lane's job; check.yml's pairing_smoke
+    # --inject-loss covers the ladder step per push.
+    def test_device_loss_walks_down_and_up_with_exact_verdicts(self):
+        """The tentpole walk: lose a lane -> quarantine + 7-lane
+        sub-mesh rebuild; an unattributed fault -> single chip; fault
+        clears -> climb back to the full mesh.  verify_batch must match
+        the host-oracle expectation bit-for-bit at EVERY rung."""
+        import jax
+
+        from consensus_overlord_tpu.crypto.tpu_provider import TpuBlsCrypto
+        from consensus_overlord_tpu.parallel import make_mesh
+
+        assert len(jax.devices()) >= 8
+        provider = TpuBlsCrypto(0xD1CE, device_threshold=1,
+                                qc_device_threshold=10**9,
+                                mesh=make_mesh(8))
+        # The long dwell parks the ladder wherever the walk-down puts
+        # it; the climb phase below zeroes it to let traffic probe up.
+        sup = MeshSupervisor(provider, step_threshold=1,
+                             probe_successes=2, probe_cooldown_s=60.0)
+        provider.attach_supervisor(sup)
+        batch = 16
+        h = sm3_hash(b"ladder-block")
+        sks = [7000 + 13 * i for i in range(batch)]
+        sigs = [oracle.sign(sk, h) for sk in sks]
+        pks = [oracle.sk_to_pk(sk) for sk in sks]
+        provider.update_pubkeys(pks)
+        sigs[3] = oracle.sign(sks[3], sm3_hash(b"other message"))
+        want = [i != 3 for i in range(batch)]
+
+        def verify():
+            return provider.verify_batch(sigs, [h] * batch, pks)
+
+        assert verify() == want
+        assert sup.rung == "full_mesh" and provider._kernels.lanes == 8
+
+        # Rung 2: lose lane 5 — quarantined, sub-mesh rebuilt over the
+        # 7 survivors, and the faulted batch still verdicts exactly
+        # (host fallback for the one that died mid-flight).
+        lane = provider.mesh_device_names()[5]
+        provider.inject_device_loss(lane, seconds=3600.0)
+        assert verify() == want
+        assert sup.rung == "sub_mesh"
+        assert sup.quarantined_devices() == [lane]
+        assert provider._kernels.lanes == 7
+        assert lane not in provider._current_lane_names()
+        # The rebuilt sub-mesh dispatches clean while the lane is still
+        # lost — this is the self-healing claim, not just a fallback.
+        fallbacks0 = provider.breaker.total_fallbacks
+        assert verify() == want
+        assert provider.breaker.total_fallbacks == fallbacks0
+
+        # Rung 3: an unattributed injected fault (no .device, no
+        # straggler flag) condemns the whole mesh -> single chip.
+        provider.breaker.inject_faults(0.001, min_faults=1)
+        assert verify() == want
+        provider.breaker.clear_injected_faults()
+        assert sup.rung == "single_chip"
+        assert provider._kernels.lanes == 1
+        assert verify() == want  # single-chip kernels, exact verdicts
+
+        # Fault clears: traffic probes the ladder back to the top.
+        provider.inject_device_loss(lane, seconds=0.0)
+        sup.probe_cooldown_s = 0.0
+        for _ in range(12):
+            assert verify() == want
+            if sup.rung == "full_mesh":
+                break
+        assert sup.rung == "full_mesh"
+        assert provider._kernels.lanes == 8
+        assert sup.quarantined_devices() == []
+        walked = [(tr["from"], tr["to"]) for tr in sup.statusz()["recent"]]
+        assert ("full_mesh", "sub_mesh") in walked
+        assert ("sub_mesh", "single_chip") in walked
+        assert ("single_chip", "sub_mesh") in walked
+        assert ("sub_mesh", "full_mesh") in walked
+
+
+# ---------------------------------------------------------------------------
+# Seeded device_loss / dcn_stall chaos through the real CLI
+# ---------------------------------------------------------------------------
+
+class TestMeshChaosRun:
+    def test_schedule_draws_are_append_only(self):
+        """The new mesh draws ride AFTER every legacy draw: seeds must
+        reproduce the exact legacy schedule when mesh counts are 0, and
+        adding mesh events must not perturb the legacy prefix."""
+        from consensus_overlord_tpu.sim import ChaosSchedule
+
+        legacy = ChaosSchedule.generate(7, heights=12, n_validators=4)
+        mesh = ChaosSchedule.generate(7, heights=12, n_validators=4,
+                                      device_losses=2, dcn_stalls=1)
+        n = len(legacy.events)
+        assert mesh.events[:n] == legacy.events
+        extra = mesh.events[n:]
+        assert sorted(e.kind for e in extra) == [
+            "dcn_stall", "device_loss", "device_loss"]
+        for e in extra:
+            if e.kind == "device_loss":
+                assert 0 <= e.device < 8
+            assert e.duration_s > 0
+
+    def test_seeded_mesh_chaos_run_exits_zero(self):
+        """sim/run.py --chaos with device_loss + dcn_stall events: the
+        fleet commits every height with zero safety violations, the
+        supervisors walk (and re-climb) the ladder, and the summary
+        carries the transition history."""
+        import json
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-m", "consensus_overlord_tpu.sim.run",
+             "--validators", "4", "--heights", "6", "--interval-ms", "40",
+             "--crypto", "simhash", "--chaos", "--seed", "7",
+             "--chaos-crashes", "0", "--chaos-stalls", "0",
+             "--chaos-partitions", "0",
+             "--chaos-device-losses", "2", "--chaos-dcn-stalls", "1",
+             "--chaos-mesh-window-ms", "300", "--shared-frontier"],
+            capture_output=True, text=True, timeout=300, cwd=repo,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+        summary = json.loads(out.stdout.splitlines()[-1])
+        assert summary["chaos"]["safety_violations"] == 0
+        # Per-event stat dicts, one per fired window, lane attributed.
+        losses = summary["chaos"]["device_losses"]
+        stalls = summary["chaos"]["dcn_stalls"]
+        assert len(losses) == 2 and len(stalls) == 1, (losses, stalls)
+        assert all(0 <= e["device"] < 8 for e in losses), losses
+        assert summary["chaos"]["events_fired"] == 3
+        assert "ladder" in summary
+        rungs = {s["rung"] for s in summary["ladder"]["supervisors"]}
+        assert rungs == {"full_mesh"}  # drained back to healthy
