@@ -251,6 +251,22 @@ class TenantLane:
         return await self.verify(*claims, msg_type=type(msg).__name__,
                                  critical=is_critical(msg))
 
+    def verify_msg_nowait(self, msg):
+        """Sync-admission twin of verify_msg: ``True`` when the message
+        carries no frontier-checkable claim, else an awaitable verdict
+        whose claim is ALREADY enqueued at the core (see
+        SharedFrontier.submit_nowait).  The sim fabric's per-tick batch
+        injection submits every claim in a delivery pass before awaiting
+        any, so one linger window covers the whole pass."""
+        claims = signature_claims(msg)
+        if claims is None:
+            return True
+        signature, hash32, voter = claims
+        critical = is_critical(msg) and self.priority_lanes
+        return self._core.submit_nowait(
+            self, bytes(signature), bytes(hash32), bytes(voter),
+            type(msg).__name__, critical)
+
     async def verify_aggregated(self, agg_sig: bytes, hash32: bytes,
                                 voters) -> bool:
         return await self._core.verify_aggregated(agg_sig, hash32, voters)
@@ -375,7 +391,19 @@ class SharedFrontier:
 
     async def submit(self, lane: TenantLane, signature: bytes, hash32: bytes,
                      voter: bytes, msg_type: str, critical: bool) -> bool:
-        """One tenant verify: enqueue under the bound, shed over it.
+        """One tenant verify: enqueue under the bound, shed over it."""
+        return await self.submit_nowait(lane, signature, hash32, voter,
+                                        msg_type, critical)
+
+    def submit_nowait(self, lane: TenantLane, signature: bytes,
+                      hash32: bytes, voter: bytes, msg_type: str,
+                      critical: bool):
+        """Sync-admission submit: bookkeeping and enqueue happen on the
+        caller's loop slice; the verdict comes back as an awaitable (the
+        entry future — or the shed coroutine on bound overflow).  Batch
+        callers submit every claim first, then await, so one linger
+        window covers them all instead of one per message.
+
         The bound counts OUTSTANDING work (waiting + composed-but-
         unresolved): composition drains the waiting queue at every
         flush whatever the device is doing, so a pending-only bound
@@ -385,7 +413,7 @@ class SharedFrontier:
             lane.tenant_stats.critical_requests += 1
         if lane.outstanding_count() >= lane.queue_bound:
             self.stats.sheds += 1
-            return await self._shed(lane, signature, hash32, voter, msg_type)
+            return self._shed(lane, signature, hash32, voter, msg_type)
         self.stats.requests += 1
         fut = asyncio.get_running_loop().create_future()
         entry = (signature, hash32, voter, fut, msg_type,
@@ -397,7 +425,7 @@ class SharedFrontier:
         elif self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.get_running_loop().create_task(
                 self._linger_then_flush())
-        return await fut
+        return fut
 
     async def _shed(self, lane: TenantLane, signature: bytes, hash32: bytes,
                     voter: bytes, msg_type: str) -> bool:

@@ -434,6 +434,90 @@ class Engine:
         self.handler.send_msg(msg)
         return True
 
+    async def inject_inbound_batch(self, msgs) -> int:
+        """Batched twin of inject_inbound for the sharded sim fabric's
+        per-tick delivery passes (sim/router.py): every frontier claim
+        in the batch is submitted synchronously before any verdict is
+        awaited, so ONE linger window covers the whole pass — and the
+        await is a gather over already-enqueued futures, not a task per
+        message.  Mailbox order preserves arrival order.  Returns the
+        number of messages accepted."""
+        if self.frontier is None:
+            for msg in msgs:
+                self.handler.send_msg(msg)
+            return len(msgs)
+        nowait = getattr(self.frontier, "verify_msg_nowait", None)
+        if nowait is None:
+            accepted = 0
+            for msg in msgs:
+                if await self.inject_inbound(msg):
+                    accepted += 1
+            return accepted
+        span_id, parent, start_us = self._child_span_begin()
+        entries = []  # (msg, sync verdict or None, awaitable index)
+        pending = []
+        for msg in msgs:
+            # Choke-storm collapse: a fleet-scale storm pass is almost
+            # entirely chokes the handler would drop unread (stale
+            # height/round, or a re-broadcast from an already-counted
+            # sender — explicitly NOT replay-counted, see
+            # _on_signed_choke).  Dropping them BEFORE the frontier
+            # claim skips their signature verification, which is what
+            # turns a 1000-validator storm round from ~n^2 verifies
+            # into <= n.
+            if isinstance(msg, SignedChoke) and self._choke_predrop(msg):
+                continue
+            verdict = nowait(msg)
+            if verdict is True or verdict is False:
+                entries.append((msg, verdict, -1))
+            else:
+                entries.append((msg, None, len(pending)))
+                pending.append(verdict)
+        results = (await asyncio.gather(*pending, return_exceptions=True)
+                   if pending else [])
+        accepted = 0
+        for msg, verdict, idx in entries:
+            ok = verdict if idx < 0 else results[idx]
+            if isinstance(ok, BaseException):
+                # Frontier contract is degrade-to-False, never raise; a
+                # raise here is infra breakage — drop the message, keep
+                # the batch.
+                logger.warning("%s: frontier verify errored for %s: %r",
+                               self._tag(), type(msg).__name__, ok)
+                ok = False
+            if ok:
+                self.handler.send_msg(msg)
+                accepted += 1
+            else:
+                logger.warning("%s: frontier dropped %s (bad signature)",
+                               self._tag(), type(msg).__name__)
+                self._reject_byzantine("bad_sig_frontier",
+                                       msg=type(msg).__name__)
+                if self.recorder is not None:
+                    self.recorder.record("frontier_drop",
+                                         msg_type=type(msg).__name__,
+                                         height=self.height,
+                                         round=self.round)
+        self._emit_span("consensus.frontier_verify_batch", span_id, parent,
+                        start_us, {"n": str(len(msgs)),
+                                   "accepted": str(accepted)})
+        return accepted
+
+    def _choke_predrop(self, sc: SignedChoke) -> bool:
+        """Would _on_signed_choke drop this choke before even verifying
+        it?  Mirrors its pre-verify early-outs against CURRENT engine
+        state.  Future-height chokes are kept (the mailbox may drain
+        after a commit advances us), so the only behavioral delta vs
+        the sequential path is skipped work for dead messages."""
+        c = sc.choke
+        if c.height != self.height:
+            return c.height < self.height
+        if c.round < self.round:
+            return True
+        if c.round - self.round > self.ROUND_WINDOW:
+            return True
+        return sc.address in self._chokes.get(c.round, ())
+
     # -- internals ---------------------------------------------------------
 
     def _tag(self) -> str:

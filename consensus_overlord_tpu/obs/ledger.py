@@ -103,6 +103,12 @@ SOAK_DIMENSIONS: Dict[str, bool] = {  # name -> higher_is_better
     "flightrec_drop_per_s": False,
     "commit_rate_heights_per_s": True,
     "compile_cache_hit_ratio": True,
+    # Fleet-shape dims (sim/run.py writes them since the sharded
+    # fabric): gating them means a lane can't quietly shrink its fleet
+    # — a 1000-validator soak record that suddenly reports 250
+    # validators is a regression of the LANE, not a perf datum.
+    "validators": True,
+    "shards": True,
 }
 SOAK_BAND = 0.50
 

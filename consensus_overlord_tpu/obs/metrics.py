@@ -60,6 +60,14 @@ multiple nodes can live in one test process):
              consensus_byzantine_rejections_total{reason} — adversarial
              messages the guards turned away (forged QC sigs, tampered
              bitmaps, equivocating proposals, replays, non-validators)
+  sim        sim_router_tick_batch{shard} — messages coalesced per
+             delivery pass of the sharded sim fabric's per-shard pump
+             (sim/router.py); the batch factor IS the task-churn
+             reduction vs the flat task-per-message router;
+             sim_router_delivery_wait_seconds{shard} — admission-to-
+             delivery wait per message (injected delay + tick
+             quantization + pump backlog: a drifting tail means the
+             pump can't keep up with the fleet's offered load)
   wal        wal_append_ms, wal_fsync_ms, wal_corruptions_total
   degraded   crypto_device_failures_total{path},
              crypto_host_fallbacks_total{path},
@@ -112,6 +120,11 @@ ROUND_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
 #: Real-lane fraction of a padded device batch (1.0 = the batch exactly
 #: filled its pad rung).
 OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+#: Sim fabric delivery-pass sizes: 1 = no coalescing (task-per-message
+#: parity), the top rungs are 1000-validator broadcast storms landing in
+#: one tick.
+TICK_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                      4096)
 #: Device stage durations in SECONDS: sim-provider stages run ~100 us,
 #: a real readback over a remote PJRT link ~150 ms, a cold jit compile
 #: minutes — one family must hold all three.
@@ -305,6 +318,20 @@ class Metrics:
             "(bad_qc_sig, bad_bitmap, subquorum, equivocation, replay, "
             "non_validator, bad_sig)",
             ["reason"], registry=self.registry)
+
+        # -- sim fabric (sim/router.py) -----------------------------------
+        self.sim_router_tick_batch = Histogram(
+            "sim_router_tick_batch",
+            "Messages coalesced into one delivery pass of a sim fabric "
+            "shard pump (the task-churn reduction factor vs "
+            "task-per-message delivery)",
+            ["shard"], buckets=TICK_BATCH_BUCKETS, registry=self.registry)
+        self.sim_router_delivery_wait_seconds = Histogram(
+            "sim_router_delivery_wait_seconds",
+            "Admission-to-delivery wait per sim fabric message "
+            "(injected delay + tick quantization + pump backlog)",
+            ["shard"], buckets=STAGE_SECONDS_BUCKETS,
+            registry=self.registry)
 
         # -- WAL (engine/wal.py) ------------------------------------------
         self.wal_append_ms = Histogram(

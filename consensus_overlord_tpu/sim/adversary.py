@@ -348,6 +348,7 @@ class Replayer(Behavior):
                        if a != self.shim.name)
         if not peers:
             return
+        immediate: List[Tuple[str, bytes, Address]] = []
         for _ in range(self.PER_SEND):
             msg_type, payload = self._log[
                 self.rng.randrange(len(self._log))]
@@ -374,7 +375,20 @@ class Replayer(Behavior):
             if delay > 0:
                 loop.call_later(delay, _fire)
             else:
-                _fire()
+                immediate.append((msg_type, payload, target))
+        if immediate:
+            # One task for the whole zero-delay burst: router admission
+            # is synchronous, so task-per-replay is pure scheduler
+            # churn — at fleet scale a flood behavior fires thousands
+            # of these per height (same batching story as the sharded
+            # fabric's pump passes, sim/router.py).
+            async def _burst(items=immediate):
+                for mt, pl, tgt in items:
+                    await self.shim.router.send(self.shim.name, tgt,
+                                                mt, pl)
+            task = loop.create_task(_burst())
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
         self.record("adversary_replay", count=self.PER_SEND)
 
     async def on_broadcast(self, msg_type: str, payload: bytes) -> None:

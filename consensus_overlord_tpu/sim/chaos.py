@@ -509,13 +509,29 @@ class ChaosRunner:
 
     async def _partition_flip(self, ev: ChaosEvent) -> None:
         """Isolate a minority (≤ f) group so the majority keeps
-        committing; heal after the window."""
+        committing; heal after the window.
+
+        On a sharded fabric (net.shards > 1) the window ROLLS: it is
+        split into one sub-window per shard, each isolating a different
+        f-sized run of consecutive validators.  Consecutive indices map
+        round-robin onto shards, so every sub-window's minority spans
+        shard boundaries — the cut crosses the inter-shard trunk, not
+        just intra-shard edges — and successive sub-windows sweep the
+        cut around the whole fleet.  The picks derive from the event
+        alone (no RNG draws: the schedule's append-only draw-order
+        contract, SIM001, stays intact)."""
         nodes = self.net.nodes
-        f = max(1, (len(nodes) - 1) // 3)
-        minority = {nodes[i].name for i in range(f)}
-        majority = {n.name for n in nodes} - minority
-        self.net.router.set_partition(majority, minority)
-        await asyncio.sleep(ev.duration_s)
+        n = len(nodes)
+        f = max(1, (n - 1) // 3)
+        shards = max(1, getattr(self.net, "shards", 1))
+        windows = shards if shards > 1 else 1
+        sub_s = ev.duration_s / windows
+        all_names = {node.name for node in nodes}
+        for w in range(windows):
+            start = (w * f) % n
+            minority = {nodes[(start + j) % n].name for j in range(f)}
+            self.net.router.set_partition(all_names - minority, minority)
+            await asyncio.sleep(sub_s)
         self.net.router.set_partition()  # heal
 
     def _frontier_batches(self) -> int:

@@ -14,9 +14,14 @@ from ..engine.smr import Engine
 from ..engine.wal import MemoryWal
 from ..ports import Wal
 from .controller import SimController
-from .router import Router
+from .router import DEFAULT_TICK_S, Router, ShardedRouter
 
 logger = logging.getLogger("consensus_overlord_tpu.sim")
+
+#: Decode-dedup cache bound for the batch sink — cleared wholesale on
+#: overflow (adversary floods are the only unique-payload firehose).
+_DECODE_CACHE_MAX = 4096
+_MISSING = object()
 
 
 class SimAdapter:
@@ -179,7 +184,9 @@ class SimNetwork:
                  sim_device_crypto: bool = False,
                  device_breaker_cooldown_s: float = 0.25,
                  profiler=None, frontier_factory=None,
-                 shared_frontier=None):
+                 shared_frontier=None, shards: int = 1,
+                 shard_workers: str = "inline",
+                 router_tick_s: float = DEFAULT_TICK_S):
         """metrics: one shared obs.Metrics for the whole fleet (histograms
         aggregate across nodes — fine for sim-level batch/round shape).
         profiler: one shared obs.prof.DeviceProfiler — providers with a
@@ -198,7 +205,11 @@ class SimNetwork:
         shared_frontier: the SharedFrontier core behind frontier_factory
         lanes, when the fleet rides one — held for introspection (chaos
         tenant events, run summaries); the caller owns its lifecycle
-        (SimNetwork.stop never closes it)."""
+        (SimNetwork.stop never closes it).
+        shards / shard_workers / router_tick_s: the sharded fabric shape
+        (sim/router.py ShardedRouter) — S per-shard pumps in "inline"
+        (deterministic, CI) or "thread" (per-shard worker thread) mode,
+        delivering per-tick batches through the decode-dedup sink."""
         from ..obs.flightrec import FlightRecorder
 
         if crypto_factory is None:
@@ -210,8 +221,13 @@ class SimNetwork:
 
             crypto_factory = lambda i: sim_crypto(  # noqa: E731
                 i.to_bytes(4, "big") * 8)
-        self.router = Router(seed=seed, drop_rate=drop_rate,
-                             delay_range=delay_range)
+        self.shards = max(1, int(shards))
+        self.shard_workers = shard_workers
+        self.router = ShardedRouter(seed=seed, drop_rate=drop_rate,
+                                    delay_range=delay_range,
+                                    shards=self.shards,
+                                    worker=shard_workers,
+                                    tick_s=router_tick_s, metrics=metrics)
         cryptos = [crypto_factory(i) for i in range(n_validators)]
         if sim_device_crypto:
             from ..crypto.breaker import CircuitBreaker
@@ -247,7 +263,59 @@ class SimNetwork:
                               profiler=profiler,
                               frontier_factory=frontier_factory)
                       for i, c in enumerate(cryptos)]
+        self._by_addr: Dict[bytes, SimNode] = {n.name: n for n in self.nodes}
+        self._decode_cache: Dict[tuple, object] = {}
+        self.router.set_batch_sink(self._deliver_batch)
         self.controller.on_new_height.append(self._push_status)
+
+    async def _deliver_batch(self, items) -> None:
+        """Per-shard pump sink (sim/router.py BatchSink): decode each
+        unique wire payload ONCE per fleet — a broadcast reaches n-1
+        inboxes but is one cache entry (message types are frozen
+        dataclasses, so sharing the decoded object is safe) — then
+        inject per target engine as one batch, so a single frontier
+        linger window covers the whole delivery pass."""
+        cache = self._decode_cache
+        by_node: Dict[bytes, list] = {}
+        for target, sender, msg_type, payload in items:
+            key = (msg_type, payload)
+            msg = cache.get(key, _MISSING)
+            if msg is _MISSING:
+                try:
+                    msg = decode_wire_message(msg_type, payload)
+                except Exception:  # noqa: BLE001 — malformed is never fatal
+                    msg = None
+                    logger.warning("dropped malformed %s", msg_type)
+                if len(cache) >= _DECODE_CACHE_MAX:
+                    cache.clear()
+                cache[key] = msg
+            if msg is None:
+                continue
+            by_node.setdefault(target, []).append(msg)
+        coros = []
+        for target, msgs in by_node.items():
+            node = self._by_addr.get(target)
+            # The router only delivers to registered addresses, so a
+            # stale or missing cache entry (tests may swap net.nodes[i]
+            # directly after a crash, bypassing restart_node) means a
+            # fresh SimNode re-registered under this name: re-resolve
+            # from the live roster rather than feeding a dead engine.
+            if node is None or not node.engine.running:
+                for cand in self.nodes:
+                    if cand.name == target:
+                        node = cand
+                        if cand.engine.running:
+                            break
+                if node is not None:
+                    self._by_addr[target] = node
+            if node is not None:
+                coros.append(node.engine.inject_inbound_batch(msgs))
+        if not coros:
+            return
+        for res in await asyncio.gather(*coros, return_exceptions=True):
+            if isinstance(res, BaseException) \
+                    and not isinstance(res, asyncio.CancelledError):
+                logger.warning("batch inject failed: %r", res)
 
     def dump_flight_recorders(self, n: Optional[int] = None) -> str:
         """Every node's flight-recorder tail, labeled — attach to test
@@ -310,6 +378,9 @@ class SimNetwork:
         # must not silently end profiling for the rest of the run.
         node.engine.profile = old.engine.profile
         self.nodes[i] = node
+        # Same address, new object: the batch sink routes by address
+        # (and ShardedRouter re-homes it on its sticky shard).
+        self._by_addr[node.name] = node
         node.start(self.controller.latest_height + 1,
                    self.controller.block_interval_ms,
                    self.controller.authority_list())
@@ -326,3 +397,4 @@ class SimNetwork:
 
     async def stop(self) -> None:
         await asyncio.gather(*(n.stop() for n in self.nodes))
+        self.router.close()

@@ -21,6 +21,15 @@ import time
 #: coalesced device-batched pipeline (--no-frontier overrides).
 FLEET_FRONTIER_MIN = 16
 
+#: Fleet-scale fabric defaults: at this many validators the sim fabric
+#: shards (sim/router.py ShardedRouter) and — when --interval-ms was
+#: left at its default — the base round timer scales with fleet size,
+#: because a 100 ms timer that n=4 meets easily is a guaranteed
+#: choke/view-change storm at n=1000 (every overrun makes all n nodes
+#: broadcast chokes: O(n^2) traffic that delays the next round further).
+FLEET_SHARD_MIN = 256
+FLEET_DEFAULT_SHARDS = 8
+
 
 def _assert_adversarial(metrics, chaos, snapshot, net) -> None:
     """Chaos acceptance beyond safety+liveness: every active adversary
@@ -184,6 +193,18 @@ def main() -> None:
                         help="commit this many heights (--target-height "
                         "is an alias)")
     parser.add_argument("--interval-ms", type=int, default=100)
+    parser.add_argument("--shards", type=int, default=0,
+                        help="sim fabric shards (sim/router.py "
+                        "ShardedRouter); 0 = auto "
+                        f"({FLEET_DEFAULT_SHARDS} at "
+                        f">={FLEET_SHARD_MIN} validators, else 1)")
+    parser.add_argument("--shard-workers", choices=("inline", "thread"),
+                        default="inline",
+                        help="per-shard pump workers: 'inline' (asyncio "
+                        "tasks on the main loop — deterministic, the CI "
+                        "mode) or 'thread' (one worker thread per shard "
+                        "owns tick timing/trunk drain; delivery passes "
+                        "marshal back to the loop)")
     parser.add_argument("--drop-rate", type=float, default=0.0)
     parser.add_argument("--seed", type=int, default=0,
                         help="router RNG seed (drop/delay schedule); also "
@@ -370,7 +391,10 @@ def main() -> None:
                         "RECURRING seeded chaos cycles (each cycle a "
                         "fresh schedule from a derived seed, shifted "
                         "to the current height) until --soak-seconds "
-                        "is spent, then gate the telemetry drift "
+                        "of SOAK time is spent (budgeted from soak "
+                        "start, unlike the plain hold, which counts "
+                        "from fleet start), then gate the telemetry "
+                        "drift "
                         "rates (RSS slope, WAL growth, flight-"
                         "recorder drop rate, compile-cache ratio) and "
                         "emit one ledger soak BenchRecord.  Exit 3 on "
@@ -384,6 +408,13 @@ def main() -> None:
                         "BenchRecord (metric=soak-chaos-survival; "
                         "scripts/ledger.py check gates WAL-growth/"
                         "RSS-slope regressions across soaks)")
+    parser.add_argument("--soak-metric", default="soak-chaos-survival",
+                        help="ledger metric name for the soak "
+                        "BenchRecord — lanes with different fleet "
+                        "shapes must trend separately (the nightly "
+                        "1000-validator lane records fleet-soak-"
+                        "survival; ledger comparability is keyed on "
+                        "metric+unit)")
     parser.add_argument("--soak-max-rss-slope-mb", type=float,
                         default=4.0,
                         help="drift gate: max RSS slope over the "
@@ -439,6 +470,24 @@ def main() -> None:
         format="%(asctime)s %(message)s")
 
     from . import SimNetwork
+
+    # Fleet-scale fabric defaults (see FLEET_SHARD_MIN): shard count
+    # auto-resolves, and an untouched --interval-ms scales with n so the
+    # first round timer clears fleet-sized delivery instead of choking.
+    shards = args.shards
+    if shards <= 0:
+        shards = (FLEET_DEFAULT_SHARDS
+                  if args.validators >= FLEET_SHARD_MIN else 1)
+    if (args.validators >= FLEET_SHARD_MIN
+            and args.interval_ms == parser.get_default("interval_ms")):
+        # 4x headroom: a choke storm is only escapable while the capped
+        # round backoff (16 * 1.5 * interval) exceeds the cost of one
+        # full choke round (~n^2 engine injects), and the first height
+        # additionally pays JAX warm-up compiles.
+        args.interval_ms = max(args.interval_ms, 4 * args.validators)
+        print(f"fleet default: --interval-ms scaled to {args.interval_ms} "
+              f"for {args.validators} validators (pass --interval-ms "
+              "explicitly to override)")
 
     # Per-behavior counts override the round-robin --chaos-byzantine
     # assignment; naming any behavior explicitly defines the full set.
@@ -606,7 +655,9 @@ def main() -> None:
                          sim_device_crypto=True,
                          profiler=profiler,
                          frontier_factory=frontier_factory,
-                         shared_frontier=shared_core)
+                         shared_frontier=shared_core,
+                         shards=shards,
+                         shard_workers=args.shard_workers)
         # Soak telemetry: sample the fleet's drift axes on a cadence.
         # Collectors dereference net.nodes at sample time (chaos
         # crash-restarts swap node objects mid-run); WAL bytes sum the
@@ -844,9 +895,16 @@ def main() -> None:
                 # budget (measured from fleet start) is spent, one
                 # height at a time so a wedge is still a diagnosed
                 # liveness failure, not a silent hang.
-                soak_deadline = t0 + args.soak_seconds
                 soak_start_h = net.controller.latest_height
                 soak_start_t = time.perf_counter()
+                # The survival lane budgets the soak itself: at fleet
+                # scale the initial schedule + runway can alone exceed
+                # the budget measured from t0, which would yield zero
+                # recurring cycles — exactly the thing the lane exists
+                # to exercise.  The plain hold keeps t0-based budgeting
+                # (its samples are about total wall clock).
+                soak_deadline = ((soak_start_t if args.soak_chaos
+                                  else t0) + args.soak_seconds)
                 if args.soak_chaos:
                     # The survival lane: recurring seeded chaos cycles
                     # until the budget is spent.  Each cycle derives a
@@ -969,6 +1027,8 @@ def main() -> None:
             "metric": "consensus-rounds",
             "validators": args.validators,
             "heights": args.heights,
+            "shards": shards,
+            "shard_workers": args.shard_workers,
             "crypto": args.crypto,
             "tpu": args.tpu,
             "total_s": round(total, 3),
@@ -1078,6 +1138,11 @@ def main() -> None:
                 "chaos_cycles": len(soak_cycles),
                 "samples": sampler.samples_taken,
                 "safety_violations": len(net.controller.violations),
+                # Fleet-shape dims: ledger-gated (obs/ledger.py
+                # SOAK_DIMENSIONS) so the survival lane can't quietly
+                # shrink its fleet between records.
+                "validators": args.validators,
+                "shards": shards,
             }.items() if v is not None}
             out["soak_chaos"] = {
                 "cycles": soak_cycles,
@@ -1095,11 +1160,13 @@ def main() -> None:
             # dims across PRs and `check` gates WAL-growth/RSS-slope
             # regressions like perf regressions.
             soak_record = ledger.annotate({
-                "metric": "soak-chaos-survival",
+                "metric": args.soak_metric,
                 "value": soak_dims.get("commit_rate_heights_per_s", 0.0),
                 "unit": "heights/s",
                 "context": {
                     "validators": args.validators,
+                    "shards": shards,
+                    "shard_workers": args.shard_workers,
                     "seed": args.seed,
                     "chaos_seed": chaos_seed,
                     "soak_seconds": args.soak_seconds,
