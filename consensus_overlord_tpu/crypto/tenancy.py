@@ -274,6 +274,14 @@ class TenantLane:
     async def aggregate(self, signatures, voters) -> bytes:
         return await self._core.aggregate(signatures, voters)
 
+    @property
+    def last_agg_round_id(self) -> Optional[int]:
+        """Round id of the core's most recent aggregate-path dispatch
+        (the engine reads it through its lane handle right after a QC
+        verify/aggregate await to link the commit trace — see
+        SharedFrontier.last_agg_round_id)."""
+        return self._core.last_agg_round_id
+
     def close(self) -> None:
         """Lanes don't own the core (see class docstring)."""
 
@@ -333,6 +341,14 @@ class SharedFrontier:
         #: shed alternative and stalling them would wedge consensus
         #: outright rather than exercise flow control.
         self._stall_until = 0.0
+        #: Round id (obs/fleet.py) of the most recent QC aggregate-path
+        #: dispatch (verify_aggregated / aggregate): the causal commit
+        #: tracer reads it right after its await resolves, linking the
+        #: commit trace's qc_verify stage to the device-profile ring
+        #: records the dispatch produced (scripts/waterfall.py joins
+        #: both streams on the id).  Best-effort under concurrency —
+        #: provenance, not accounting.
+        self.last_agg_round_id: Optional[int] = None
         self.stats = FrontierStats()
 
     # -- tenancy -----------------------------------------------------------
@@ -466,15 +482,21 @@ class SharedFrontier:
                                 voters) -> bool:
         """QC aggregate verification off the event loop: dispatch through
         the same single ordered worker as batch flushes (device FIFO
-        stays intact), block only in a resolver thread."""
+        stays intact), block only in a resolver thread.  Like _run_batch
+        the dispatch is round-tagged, so the device-profile ring records
+        it produces join the commit trace's qc_verify stage on the id."""
         dispatch = getattr(self._provider, "verify_aggregated_async", None)
+        round_id = next_round_id()
+        self.last_agg_round_id = round_id
         try:
             if dispatch is None:
-                return await asyncio.to_thread(
-                    self._provider.verify_aggregated_signature,
-                    agg_sig, hash32, voters)
+                def _host():
+                    with tag_round(round_id):
+                        return self._provider.verify_aggregated_signature(
+                            agg_sig, hash32, voters)
+                return await asyncio.to_thread(_host)
             return await self._via_dispatcher(dispatch, agg_sig, hash32,
-                                              voters)
+                                              voters, round_id=round_id)
         except Exception:  # noqa: BLE001 — malformed input is never fatal
             logger.exception("frontier QC verification errored")
             return False
@@ -484,19 +506,36 @@ class SharedFrontier:
         Raises CryptoError on invalid input, like the sync form."""
         dispatch = getattr(self._provider, "aggregate_signatures_async",
                            None)
+        round_id = next_round_id()
+        self.last_agg_round_id = round_id
         if dispatch is None:
-            return await asyncio.to_thread(
-                self._provider.aggregate_signatures, signatures, voters)
-        return await self._via_dispatcher(dispatch, signatures, voters)
+            def _host():
+                with tag_round(round_id):
+                    return self._provider.aggregate_signatures(signatures,
+                                                               voters)
+            return await asyncio.to_thread(_host)
+        return await self._via_dispatcher(dispatch, signatures, voters,
+                                          round_id=round_id)
 
-    async def _via_dispatcher(self, dispatch, *args):
+    async def _via_dispatcher(self, dispatch, *args, round_id=None):
         """dispatch(*args) on the ordered worker → resolve() in a second
         thread (overlaps the dispatch→readback round-trip with device
-        compute, same pipeline as _run_batch)."""
+        compute, same pipeline as _run_batch).  round_id tags both
+        threads (thread-local, like _run_batch) so profiler records
+        land under it."""
         loop = asyncio.get_running_loop()
-        resolver = await loop.run_in_executor(self._dispatcher, dispatch,
-                                              *args)
-        return await asyncio.to_thread(resolver)
+
+        def _dispatch():
+            with tag_round(round_id):
+                return dispatch(*args)
+
+        resolver = await loop.run_in_executor(self._dispatcher, _dispatch)
+
+        def _resolve():
+            with tag_round(round_id):
+                return resolver()
+
+        return await asyncio.to_thread(_resolve)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -38,6 +38,12 @@ src/main.rs:96, 111, 137).  Here:
                  telemetry series (occupancy collapse, stage-time
                  spike, shed storm, straggler persistence) →
                  obs_alerts_total{kind} + the /statusz "alerts" section
+  causal.py    — CommitTracer: causal commit tracing — router delivery
+                 envelopes + engine events assembled into per-height
+                 critical paths (exact-partition stage attribution),
+                 exported as Perfetto JSON, cross-node Jaeger spans,
+                 consensus_commit_latency_seconds{stage} and the
+                 /statusz "commits" section
   logctx.py    — logging init from LogConfig + W3C traceparent extraction
                  from gRPC metadata into contextvars, stamped onto every
                  log record (the `set_parent` analog); per-request server
@@ -75,6 +81,10 @@ _EXPORTS = {
     "AnomalyDetector": "anomaly",
     "JaegerExporter": "tracing",
     "Span": "tracing",
+    "CommitTrace": "causal",
+    "CommitTracer": "causal",
+    "STAGES": "causal",
+    "height_trace_id": "causal",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -21,9 +21,12 @@ Design constraints:
   * dump() renders one event per line for pytest failure output and
     sim-harness post-mortems.
 
-Event shape: {"seq": int, "ts": float, "kind": str, **fields} — kinds
-are free-form strings ("enter_round", "qc_formed", "frontier_drop", ...);
-fields must be JSON-encodable (statusz serves the tail verbatim).
+Event shape: {"seq": int, "ts": float, "mono": float, "kind": str,
+**fields} — kinds are free-form strings ("enter_round", "qc_formed",
+"frontier_drop", ...); fields must be JSON-encodable (statusz serves
+the tail verbatim).  `ts` is wall-clock for humans; `mono` is
+time.monotonic() so reconstructed timelines (scripts/waterfall.py)
+survive clock steps during soaks.
 """
 
 from __future__ import annotations
@@ -58,7 +61,7 @@ class FlightRecorder:
         """Append one event.  Hot-path cheap; never raises."""
         try:
             event = {"seq": next(self._seq), "ts": time.time(),
-                     "kind": kind}
+                     "mono": time.monotonic(), "kind": kind}
             event.update(fields)
             if len(self._events) == self.capacity:
                 self.dropped += 1  # the append below evicts the oldest
@@ -92,7 +95,7 @@ class FlightRecorder:
         out = io.StringIO()
         for e in self.tail(n):
             extras = " ".join(f"{k}={e[k]!r}" for k in e
-                              if k not in ("seq", "ts", "kind"))
+                              if k not in ("seq", "ts", "mono", "kind"))
             out.write(f"[{e['seq']:6d} {e['ts']:.6f}] "
                       f"{e['kind']:<16s} {extras}\n")
         return out.getvalue()

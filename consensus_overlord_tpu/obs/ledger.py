@@ -103,6 +103,9 @@ SOAK_DIMENSIONS: Dict[str, bool] = {  # name -> higher_is_better
     "flightrec_drop_per_s": False,
     "commit_rate_heights_per_s": True,
     "compile_cache_hit_ratio": True,
+    # Causal-tracer latency dim (obs/causal.py): the soak's rolling p50
+    # commit latency — the SLO the critical-path decomposition explains.
+    "commit_latency_p50_ms": False,
     # Fleet-shape dims (sim/run.py writes them since the sharded
     # fabric): gating them means a lane can't quietly shrink its fleet
     # — a 1000-validator soak record that suddenly reports 250

@@ -59,7 +59,10 @@ multiple nodes can live in one test process):
              consensus_committed_heights_total,
              consensus_byzantine_rejections_total{reason} — adversarial
              messages the guards turned away (forged QC sigs, tampered
-             bitmaps, equivocating proposals, replays, non-validators)
+             bitmaps, equivocating proposals, replays, non-validators);
+             consensus_commit_latency_seconds{stage} — commit latency
+             exactly partitioned into critical-path stages by the
+             causal tracer (obs/causal.py)
   sim        sim_router_tick_batch{shard} — messages coalesced per
              delivery pass of the sharded sim fabric's per-shard pump
              (sim/router.py); the batch factor IS the task-churn
@@ -318,6 +321,16 @@ class Metrics:
             "(bad_qc_sig, bad_bitmap, subquorum, equivocation, replay, "
             "non_validator, bad_sig)",
             ["reason"], registry=self.registry)
+        self.commit_latency_seconds = Histogram(
+            "consensus_commit_latency_seconds",
+            "Commit latency attributed to critical-path stages by the "
+            "causal tracer (obs/causal.py): per committed height the "
+            "enter-height -> commit interval is exactly partitioned "
+            "into proposal_propagation / router_queue_wait / trunk_hop "
+            "/ quorum_tail / qc_verify / wal_fsync / commit, plus one "
+            "'total' observation",
+            ["stage"], buckets=STAGE_SECONDS_BUCKETS,
+            registry=self.registry)
 
         # -- sim fabric (sim/router.py) -----------------------------------
         self.sim_router_tick_batch = Histogram(

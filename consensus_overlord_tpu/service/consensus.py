@@ -97,7 +97,8 @@ class Consensus:
     def __init__(self, config: ConsensusConfig, private_key: int,
                  controller: Optional[ControllerClient] = None,
                  network: Optional[NetworkClient] = None,
-                 crypto=None, tracer=None, metrics=None, recorder=None):
+                 crypto=None, tracer=None, metrics=None, recorder=None,
+                 causal=None):
         self.config = config
         # Explicit compat: method paths bake at construction, and the
         # global default is shared process-wide (rpc.full_service_name).
@@ -167,9 +168,14 @@ class Consensus:
         # tracer: the engine emits height/round/QC-verify spans through the
         # same exporter the gRPC layer uses (reference #[instrument]
         # coverage, src/consensus.rs:96,143,209).
+        # causal: the commit tracer (obs/causal.py) — receive/quorum/
+        # aggregate/WAL/commit events keyed per height, solved into
+        # critical-path stage attributions on every commit.
+        self.causal = causal
         self.engine = Engine(self.crypto.pub_key, self.brain, self.crypto,
                              self.wal, frontier=self.frontier, tracer=tracer,
-                             metrics=metrics, recorder=recorder)
+                             metrics=metrics, recorder=recorder,
+                             causal=causal)
         # Round-boundary pings drive the capture cadence; attaching here
         # (not in main.py) keeps embedded uses — tests, sim — working.
         self.engine.profile = self.profile_session

@@ -68,6 +68,13 @@ class ServiceRuntime:
         self.tracer = (JaegerExporter(lc.agent_endpoint,
                                       lc.service_name or "consensus")
                        if lc is not None and lc.agent_endpoint else None)
+        # Causal commit tracer (obs/causal.py): per-height critical-path
+        # attribution.  Its Jaeger spans ride the same exporter as the
+        # engine's — trace ids derive from the height, so every
+        # validator's spans for one height join one cross-node trace.
+        from ..obs import CommitTracer
+        self.causal = CommitTracer(metrics=self.metrics,
+                                   exporter=self.tracer)
         self.consensus: Optional[Consensus] = None
         self.sampler = None
         self.straggler = None
@@ -86,7 +93,8 @@ class ServiceRuntime:
         self.consensus = Consensus(cfg, self._private_key,
                                    tracer=self.tracer,
                                    metrics=self.metrics,
-                                   recorder=self.recorder)
+                                   recorder=self.recorder,
+                                   causal=self.causal)
         # Liveness-aware health: NOT_SERVING once the engine's height
         # stalls past the config window (grpc-health-probe in the Docker
         # HEALTHCHECK then fails and the orchestrator restarts us).
@@ -101,6 +109,9 @@ class ServiceRuntime:
             self.metrics.add_status_source("version", lambda: __version__)
             self.metrics.add_status_source("consensus", engine.status)
             self.metrics.add_status_source("health", self.health.status)
+            # Causal commit decomposition: rolling commit-latency
+            # p50/p99 + critical-path stage shares (obs/causal.py).
+            self.metrics.add_status_source("commits", self.causal.statusz)
             # Degraded-mode visibility: breaker state + host-fallback
             # counts, when the provider has a device path to degrade.
             degraded = getattr(self.consensus.crypto, "degraded_status",

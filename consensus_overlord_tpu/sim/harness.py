@@ -79,7 +79,7 @@ class SimNode:
                  use_frontier: bool = False, frontier_max_batch: int = 1024,
                  frontier_linger_s: float = 0.002, metrics=None,
                  recorder=None, node_seed: int = 0, profiler=None,
-                 frontier_factory=None):
+                 frontier_factory=None, causal=None):
         from ..crypto.frontier import BatchingVerifier
         from .adversary import AdversaryShim
 
@@ -120,7 +120,8 @@ class SimNode:
             breaker.recorder = recorder
         self.engine = Engine(crypto.pub_key, self.adversary, crypto,
                              self.wal, frontier=self.frontier,
-                             metrics=metrics, recorder=recorder)
+                             metrics=metrics, recorder=recorder,
+                             causal=causal)
         self.adversary.engine = self.engine  # leader_of follows its rotation
         self.router = router
         self._task: Optional[asyncio.Task] = None
@@ -186,7 +187,7 @@ class SimNetwork:
                  profiler=None, frontier_factory=None,
                  shared_frontier=None, shards: int = 1,
                  shard_workers: str = "inline",
-                 router_tick_s: float = DEFAULT_TICK_S):
+                 router_tick_s: float = DEFAULT_TICK_S, causal=None):
         """metrics: one shared obs.Metrics for the whole fleet (histograms
         aggregate across nodes — fine for sim-level batch/round shape).
         profiler: one shared obs.prof.DeviceProfiler — providers with a
@@ -209,7 +210,11 @@ class SimNetwork:
         shards / shard_workers / router_tick_s: the sharded fabric shape
         (sim/router.py ShardedRouter) — S per-shard pumps in "inline"
         (deterministic, CI) or "thread" (per-shard worker thread) mode,
-        delivering per-tick batches through the decode-dedup sink."""
+        delivering per-tick batches through the decode-dedup sink.
+        causal: one shared obs.causal.CommitTracer for the fleet —
+        every engine records send/receive/quorum/commit events into it
+        and the sink threads the router's delivery envelopes through,
+        so per-height commit critical paths are attributable."""
         from ..obs.flightrec import FlightRecorder
 
         if crypto_factory is None:
@@ -250,6 +255,7 @@ class SimNetwork:
         self._frontier_factory = frontier_factory
         self.shared_frontier = shared_frontier
         self._wal_factory = wal_factory
+        self.causal = causal
         self.nodes = [SimNode(c, self.router, self.controller,
                               wal=(wal_factory(i) if wal_factory is not None
                                    else None),
@@ -261,7 +267,8 @@ class SimNetwork:
                                   if flight_recorder_capacity > 0 else None),
                               node_seed=seed ^ (0x9E3779B9 * (i + 1)),
                               profiler=profiler,
-                              frontier_factory=frontier_factory)
+                              frontier_factory=frontier_factory,
+                              causal=causal)
                       for i, c in enumerate(cryptos)]
         self._by_addr: Dict[bytes, SimNode] = {n.name: n for n in self.nodes}
         self._decode_cache: Dict[tuple, object] = {}
@@ -274,10 +281,16 @@ class SimNetwork:
         inboxes but is one cache entry (message types are frozen
         dataclasses, so sharing the decoded object is safe) — then
         inject per target engine as one batch, so a single frontier
-        linger window covers the whole delivery pass."""
+        linger window covers the whole delivery pass.
+
+        Decoded messages are SHARED across targets (frozen dataclasses),
+        so per-delivery provenance cannot ride the message objects: the
+        router's delivery envelopes travel as a parallel list into
+        inject_inbound_batch instead, keyed positionally."""
         cache = self._decode_cache
         by_node: Dict[bytes, list] = {}
-        for target, sender, msg_type, payload in items:
+        env_by_node: Dict[bytes, list] = {}
+        for target, sender, msg_type, payload, env in items:
             key = (msg_type, payload)
             msg = cache.get(key, _MISSING)
             if msg is _MISSING:
@@ -292,6 +305,7 @@ class SimNetwork:
             if msg is None:
                 continue
             by_node.setdefault(target, []).append(msg)
+            env_by_node.setdefault(target, []).append(env)
         coros = []
         for target, msgs in by_node.items():
             node = self._by_addr.get(target)
@@ -309,7 +323,8 @@ class SimNetwork:
                 if node is not None:
                     self._by_addr[target] = node
             if node is not None:
-                coros.append(node.engine.inject_inbound_batch(msgs))
+                coros.append(node.engine.inject_inbound_batch(
+                    msgs, envelopes=env_by_node.get(target)))
         if not coros:
             return
         for res in await asyncio.gather(*coros, return_exceptions=True):
@@ -365,7 +380,8 @@ class SimNetwork:
                        metrics=self.metrics, recorder=old.recorder,
                        node_seed=old.adversary.seed,
                        profiler=self.profiler,
-                       frontier_factory=self._frontier_factory)
+                       frontier_factory=self._frontier_factory,
+                       causal=self.causal)
         # Adversary tallies span the crash like the flight recorder does
         # (run assertions read them after the schedule has played out);
         # so does the observed view-change window the adaptive behavior

@@ -47,10 +47,17 @@ logger = logging.getLogger("consensus_overlord_tpu.sim.router")
 Handler = Callable[[Address, str, bytes], Awaitable[None]]
 
 #: Batch sink: one await per pump pass, carrying every due delivery for
-#: the shard — [(target, sender, msg_type, payload), ...].  The harness
-#: installs one (decode-dedup + batched engine injection); without a
-#: sink the pump falls back to legacy task-per-message dispatch.
-BatchSink = Callable[[List[Tuple[bytes, bytes, str, bytes]]],
+#: the shard — [(target, sender, msg_type, payload, envelope), ...]
+#: where envelope is the delivery's provenance stamp
+#: (enq_monotonic, due_monotonic, trunk_drain_monotonic_or_0, delivered
+#: _monotonic, via_trunk) — timestamps the causal commit tracer
+#: (obs/causal.py) turns into router-queue-wait / trunk-hop stages.  The
+#: harness installs one (decode-dedup + batched engine injection);
+#: without a sink the pump falls back to legacy task-per-message
+#: dispatch (the envelope is dropped there — legacy handlers keep the
+#: (sender, msg_type, payload) shape).
+Envelope = Tuple[float, float, float, float, bool]
+BatchSink = Callable[[List[Tuple[bytes, bytes, str, bytes, Envelope]]],
                      Awaitable[None]]
 
 _U64 = float(1 << 64)
@@ -166,8 +173,11 @@ class Router:
         self._metrics = metrics
         self._sink: Optional[BatchSink] = None
         #: Pending deliveries: (due, seq, target, sender, msg_type,
-        #: payload, enqueued_at) — seq breaks due-time ties in admission
-        #: order so replays are stable.
+        #: payload, enqueued_at, via_trunk, trunk_drained_at) — seq
+        #: breaks due-time ties in admission order so replays are
+        #: stable; the trailing provenance fields feed the batch sink's
+        #: delivery envelopes and cost zero RNG draws (pure clock
+        #: reads already taken at admission).
         self._heap: List[tuple] = []
         self._seq = 0
         #: Cross-shard trunk inbox: the fabric appends admitted items
@@ -304,7 +314,8 @@ class Router:
             if hi > 0:
                 delay = lo + u_delay * (hi - lo)
         now = time.monotonic()
-        item = (now + delay, target, sender, msg_type, payload, now)
+        item = (now + delay, target, sender, msg_type, payload, now,
+                via_trunk, 0.0)
         with self._lock:
             if via_trunk:
                 self._trunk_in.append(item)
@@ -338,9 +349,13 @@ class Router:
     def _drain_trunk_locked(self) -> None:
         if self._trunk_in:
             self.trunk_drains += 1
+            drained_at = time.monotonic()
             for item in self._trunk_in:
                 self._seq += 1
-                heapq.heappush(self._heap, (item[0], self._seq) + item[1:])
+                # Stamp the trunk-hop completion (the causal tracer's
+                # trunk_hop stage is drained_at - enqueued_at).
+                heapq.heappush(self._heap, (item[0], self._seq)
+                               + item[1:7] + (drained_at,))
             self._trunk_in = []
 
     def _collect(self, now: float) -> List[tuple]:
@@ -420,12 +435,14 @@ class Router:
         self.max_tick_batch = max(self.max_tick_batch, n)
         live: List[tuple] = []
         waits: List[float] = []
-        for due, _seq, target, sender, msg_type, payload, enq in batch:
+        for (due, _seq, target, sender, msg_type, payload, enq,
+             via_trunk, drained_at) in batch:
             # A node that crashed after admission is off the network:
             # its in-flight messages vanish (the flat fabric fired them
             # into the dead handler instead).
             if target in self._handlers:
-                live.append((target, sender, msg_type, payload))
+                live.append((target, sender, msg_type, payload,
+                             (enq, due, drained_at, now, via_trunk)))
                 waits.append(now - enq)
                 self.wait_total_s += now - enq
         self.delivered += len(live)
@@ -447,7 +464,7 @@ class Router:
                                  self.shard_id, len(live))
             return
         loop = asyncio.get_running_loop()
-        for target, sender, msg_type, payload in live:
+        for target, sender, msg_type, payload, _env in live:
             handler = self._handlers.get(target)
             if handler is None:
                 continue

@@ -356,6 +356,15 @@ def main() -> None:
                         "this low so coalesced batches actually ride "
                         "the chip)")
     parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("--critpath-out", default=None, metavar="PATH",
+                        help="write the causal commit tracer's Perfetto/"
+                        "Chrome-trace JSON here at run end — one file "
+                        "that loads in ui.perfetto.dev AND carries the "
+                        "embedded 'critpath' payload scripts/"
+                        "waterfall.py --critical-path consumes "
+                        "(obs/causal.py; the tracer itself is always "
+                        "on: the summary's 'critpath' block and the "
+                        "/statusz 'commits' section cost nothing extra)")
     parser.add_argument("--statusz-port", type=int, default=None,
                         help="serve /metrics + /statusz on this port for "
                         "the duration of the run (0 = OS-assigned)")
@@ -434,6 +443,14 @@ def main() -> None:
                         help="drift gate: min compile-cache hit ratio "
                         "at soak end (0 disables; CPU sims may never "
                         "touch the cache)")
+    parser.add_argument("--soak-max-commit-latency-drift", type=float,
+                        default=3.0,
+                        help="drift gate: max second-half/first-half "
+                        "p50 commit-latency ratio over the causal "
+                        "tracer's window (obs/causal.py) — a chain "
+                        "whose commits keep getting slower is leaking "
+                        "capacity even when RSS and WAL stay flat "
+                        "(<= 0 disables)")
     parser.add_argument("--soak-max-alerts", type=int, default=None,
                         help="alert gate: fail the soak (exit 3, like a "
                         "drift breach) when the anomaly layer "
@@ -592,6 +609,13 @@ def main() -> None:
         from ..obs.telemetry import wal_size_bytes
 
         metrics = Metrics()
+        # Causal commit tracer: one shared instance for the whole fleet
+        # (the shared instance is the cross-node trace-context channel)
+        # — pure clock reads, zero RNG draws, so the seed contract and
+        # golden fixtures are untouched.
+        from ..obs.causal import CommitTracer
+
+        causal = CommitTracer(metrics=metrics)
         # Staged round profiles ride every run (the "profile" block in
         # the JSON summary); XLA capture only when --profile-dir names
         # a destination.
@@ -657,7 +681,8 @@ def main() -> None:
                          frontier_factory=frontier_factory,
                          shared_frontier=shared_core,
                          shards=shards,
-                         shard_workers=args.shard_workers)
+                         shard_workers=args.shard_workers,
+                         causal=causal)
         # Soak telemetry: sample the fleet's drift axes on a cadence.
         # Collectors dereference net.nodes at sample time (chaos
         # crash-restarts swap node objects mid-run); WAL bytes sum the
@@ -744,6 +769,9 @@ def main() -> None:
             # Drift over the retained sample window — the live answer
             # to "is anything creeping" without reading the JSONL.
             metrics.add_status_source("trend", sampler.trend)
+            # Causal commit-latency decomposition: rolling p50/p99 +
+            # per-stage shares over the tracer's window (obs/causal.py).
+            metrics.add_status_source("commits", causal.statusz)
             # Fleet observability sections: per-device straggler state,
             # the anomaly-alert ring, and the (degenerate, single-
             # process) cross-host trend merge.
@@ -1059,7 +1087,16 @@ def main() -> None:
             # (summary-side twin of /statusz "alerts") and, when the
             # straggler detector ran, its per-device medians ("mesh").
             "alerts": anomaly.statusz(8),
+            # Causal commit decomposition (summary-side twin of the
+            # /statusz "commits" section): rolling latency quantiles +
+            # mean critical-path stage shares over the tracer's window.
+            "critpath": causal.summary(),
         }
+        if args.critpath_out:
+            with open(args.critpath_out, "w") as f:
+                json.dump(causal.to_perfetto(), f)
+            print(f"critpath: {out['critpath']['commits']} commit "
+                  f"traces -> {args.critpath_out}")
         if straggler is not None:
             out["mesh"] = straggler.statusz()
         if supervisors:
@@ -1108,6 +1145,18 @@ def main() -> None:
                 "min_compile_cache_hit_ratio": args.soak_min_cache_ratio,
             }
             drift_failures = drift_check(trend, thresholds)
+            # Commit-latency drift rides the same verdict as RSS/WAL
+            # growth: a chain that keeps committing but ever slower is
+            # a capacity leak the byte-counting gates can't see.
+            latency_drift = causal.drift_ratio()
+            if (args.soak_max_commit_latency_drift > 0
+                    and latency_drift is not None
+                    and latency_drift > args.soak_max_commit_latency_drift):
+                drift_failures.append(
+                    f"commit latency p50 drift: second-half/first-half "
+                    f"ratio {latency_drift:.2f} exceeds "
+                    f"--soak-max-commit-latency-drift "
+                    f"{args.soak_max_commit_latency_drift}")
             # Synthetic alert storm: the CI fixture for the alert gate —
             # raised through the real raise_alert path so the counter,
             # flightrec event, and /statusz section all light up.
@@ -1134,6 +1183,12 @@ def main() -> None:
                 "commit_rate_heights_per_s":
                     (round(soak_heights / soak_wall_s, 4)
                      if soak_wall_s > 0 else None),
+                "commit_latency_p50_ms":
+                    (round(out["critpath"]["p50_ms"], 3)
+                     if out["critpath"]["commits"] else None),
+                "commit_latency_drift_ratio":
+                    (round(latency_drift, 4)
+                     if latency_drift is not None else None),
                 "breaker_cycles": breaker_cycles,
                 "chaos_cycles": len(soak_cycles),
                 "samples": sampler.samples_taken,

@@ -22,17 +22,33 @@ Input files are auto-detected by shape:
 Usage:
   python scripts/waterfall.py summary.json [more.json ...] [--json]
       [--rounds K] [--round ID]
+  python scripts/waterfall.py --critical-path cp.json [--json]
 
 Text rendering goes to stdout; --json instead emits one structured
 document {"rounds": [...], "count": N} (the CI contract: nightly
 fleet-obs-smoke asserts >= 3 reconstructed rounds from a sim summary).
 Exit 0 with >= 1 round reconstructed, 4 when no round-tagged data was
 found (distinct from argparse's 2).
+
+--critical-path switches to commit-trace mode: the inputs are
+``--critpath-out`` dumps from sim/run.py (or any JSON carrying the
+"critpath" payload obs/causal.py exports).  Every traced height
+renders as a stage waterfall with the critical (dominant-share) stage
+highlighted; --json emits {"heights": [...], "count": N}.  Exit 5 when
+no commit-tagged data was found (distinct from the round mode's 4).
+
+Timelines prefer the flight recorder's monotonic `mono` stamp over the
+wall-clock `ts` when both are present, so event ordering survives
+clock steps during soaks.
 """
 
 import argparse
 import json
 import sys
+
+#: Commit critical-path stages in causal order (obs/causal.py STAGES).
+_CRIT_STAGES = ("proposal_propagation", "router_queue_wait", "trunk_hop",
+                "quorum_tail", "qc_verify", "wal_fsync", "commit")
 
 #: Render order fallback for stages that never got a stages_at_s
 #: completion offset (older ring records): the hot path's fixed order.
@@ -95,9 +111,18 @@ def _segments(record: dict):
     return segs
 
 
+def _event_time(e: dict):
+    """Ordering key for flight-recorder events: the monotonic `mono`
+    stamp when present (immune to clock steps), wall-clock `ts`
+    otherwise."""
+    t = e.get("mono", e.get("ts"))
+    return float(t) if t is not None else 0.0
+
+
 def build_rounds(rings, events):
     """Join ring records + events on round_id → ordered round list."""
     rounds = {}
+    events = sorted(events, key=_event_time)
 
     def slot(rid):
         return rounds.setdefault(rid, {
@@ -170,8 +195,80 @@ def render_text(rounds, width: int = 44) -> str:
         for a in r["annotations"]:
             kind = a.get("kind", "?")
             extras = " ".join(f"{k}={a[k]}" for k in a
-                              if k not in ("kind", "ts", "round_id"))
+                              if k not in ("kind", "ts", "mono",
+                                           "round_id"))
             lines.append(f"  !{kind:>15s} {extras}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _load_traces(path: str):
+    """One --critpath-out dump → list of CommitTrace dicts.  Accepts
+    the full Perfetto+critpath document, a bare {"traces": [...]}
+    payload, or a bare list of trace dicts."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        cp = doc.get("critpath")
+        if isinstance(cp, dict):
+            doc = cp
+        if isinstance(doc.get("traces"), list):
+            doc = doc["traces"]
+    if not isinstance(doc, list):
+        return []
+    return [t for t in doc
+            if isinstance(t, dict) and isinstance(t.get("stages"), dict)
+            and "height" in t]
+
+
+def build_heights(traces):
+    """Group commit traces by height → ordered height list, each trace
+    annotated with its critical (dominant-share) stage."""
+    heights = {}
+    for t in traces:
+        stages = t["stages"]
+        order = [s for s in _CRIT_STAGES if s in stages]
+        order += sorted(s for s in stages if s not in _CRIT_STAGES)
+        total = float(t.get("total_s") or sum(
+            float(stages[s]) for s in order))
+        shares = t.get("shares") or {}
+        critical = max(order, key=lambda s: float(stages[s]),
+                       default=None)
+        segs, cursor = [], 0.0
+        for s in order:
+            dur = float(stages[s])
+            segs.append({"stage": s, "start_s": round(cursor, 9),
+                         "dur_s": round(dur, 9),
+                         "share": round(float(shares.get(
+                             s, dur / total if total > 0 else 0.0)), 6),
+                         "critical": s == critical})
+            cursor += dur
+        heights.setdefault(int(t["height"]), []).append({
+            "node": t.get("node", "?"), "round": t.get("round", 0),
+            "total_s": total, "via_trunk": bool(t.get("via_trunk")),
+            "path": t.get("path", "commit"),
+            "critical": critical, "segments": segs})
+    return [{"height": h, "traces": heights[h]}
+            for h in sorted(heights)]
+
+
+def render_critpath(heights, width: int = 44) -> str:
+    lines = []
+    for entry in heights:
+        lines.append(f"height {entry['height']}")
+        for t in entry["traces"]:
+            trunk = "  via_trunk" if t["via_trunk"] else ""
+            lines.append(f"  node {t['node'][:8]}  round {t['round']}  "
+                         f"total={t['total_s'] * 1e3:.3f} ms{trunk}")
+            span = max(t["total_s"], 1e-9)
+            for s in t["segments"]:
+                lead = int(s["start_s"] / span * width)
+                bar = max(int(s["dur_s"] / span * width), 1)
+                mark = "*" if s["critical"] else " "
+                lines.append(
+                    f"  {mark} {s['stage']:>20s} "
+                    f"{s['dur_s'] * 1e3:9.3f} ms {s['share'] * 100:5.1f}%  "
+                    f"{' ' * lead}{'#' * bar}")
         lines.append("")
     return "\n".join(lines)
 
@@ -190,7 +287,28 @@ def main() -> int:
                     help="render only the last K rounds")
     ap.add_argument("--round", type=int, default=None, metavar="ID",
                     help="render only this round_id")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="commit-trace mode: inputs are --critpath-out "
+                    "dumps; render per-height stage waterfalls with "
+                    "the critical stage highlighted")
     args = ap.parse_args()
+
+    if args.critical_path:
+        traces = []
+        for path in args.files:
+            traces.extend(_load_traces(path))
+        heights = build_heights(traces)
+        if args.json:
+            print(json.dumps({"heights": heights,
+                              "count": len(heights),
+                              "traces": len(traces)}))
+        else:
+            print(render_critpath(heights))
+            print(f"heights: {len(heights)}  traces: {len(traces)}")
+        if not heights:
+            print("no commit-tagged data found", file=sys.stderr)
+            return 5
+        return 0
 
     rings, events = [], []
     for path in args.files:

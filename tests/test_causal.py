@@ -1,0 +1,447 @@
+"""Causal commit tracing tests (obs/causal.py + the envelope fabric).
+
+Pins the contracts the critical-path work leans on:
+
+* the exact-partition solve — per trace the stage seconds sum to the
+  commit latency and the shares sum to 1.0, whatever events arrived
+  (missing proposal receipt, missing quorum, out-of-order clocks);
+* cross-node trace linking — every validator derives the same Jaeger
+  trace id from the height, spans carry the node address tag;
+* the envelope fabric end-to-end — traces keep flowing across a
+  restart_node crash/revive cycle at 4 shards, inter-shard deliveries
+  show up as via_trunk, and the tracer costs the fabric zero RNG draws
+  (the golden seed-7 fixtures stay byte-identical);
+* scripts/waterfall.py --critical-path reconstructs every traced
+  height and exits 5 (not 4) when no commit-tagged data is present.
+"""
+
+import asyncio
+import hashlib
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from consensus_overlord_tpu.core.types import (AggregatedSignature,
+                                               AggregatedVote, Proposal,
+                                               SignedProposal, VoteType)
+from consensus_overlord_tpu.obs.causal import (STAGES, CommitTracer,
+                                               height_trace_id)
+
+DATA = pathlib.Path(__file__).parent / "data"
+WATERFALL = pathlib.Path(__file__).parent.parent / "scripts" / "waterfall.py"
+
+NODE = b"\x01" * 8
+PEER = b"\x02" * 8
+HASH = b"\x11" * 32
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _proposal(height, round_=0, proposer=PEER):
+    return SignedProposal(
+        Proposal(height=height, round=round_, content=b"blk",
+                 block_hash=HASH, lock=None, proposer=proposer),
+        signature=b"\x00" * 96)
+
+
+def _qc(height, round_=0, vote_type=VoteType.PRECOMMIT, block_hash=HASH):
+    return AggregatedVote(
+        signature=AggregatedSignature(b"\x00" * 96, b"\x07"),
+        vote_type=vote_type, height=height, round=round_,
+        block_hash=block_hash, leader=PEER)
+
+
+class TestSolver:
+    """The exact-partition critical-path solve."""
+
+    def test_full_event_stream_partitions_exactly(self):
+        tr = CommitTracer()
+        t0 = 100.0
+        tr.on_enter_height(NODE, 5, t0)
+        # enq, due, drained (trunk), delivered, via_trunk
+        env = (t0 + 0.001, t0 + 0.004, t0 + 0.003, t0 + 0.010, True)
+        tr.on_recv(NODE, _proposal(5), t0 + 0.010, env)
+        tr.on_quorum(NODE, VoteType.PRECOMMIT, 5, 0, t0 + 0.030, votes=3)
+        tr.on_aggregate(NODE, 5, 0.002)
+        tr.on_qc_verify(NODE, 5, 0.003)
+        tr.on_wal_save(NODE, 5, 0.004)
+        tr.on_commit(NODE, 5, t0 + 0.050)
+        assert len(tr.completed) == 1
+        t = tr.completed[0]
+        assert t.height == 5 and t.node == NODE.hex()
+        assert t.via_trunk and t.quorum_votes == 3
+        assert t.total_s == pytest.approx(0.050)
+        # Exact partition: stage seconds sum to the latency, shares to 1.
+        assert sum(t.stages.values()) == pytest.approx(t.total_s)
+        assert sum(t.shares.values()) == pytest.approx(1.0)
+        assert set(t.stages) == set(STAGES)
+        # Head split: trunk = drained-enq, queue = delivered-due,
+        # propagation is the remainder of [enter, prop_recv].
+        assert t.stages["trunk_hop"] == pytest.approx(0.002)
+        assert t.stages["router_queue_wait"] == pytest.approx(0.006)
+        assert t.stages["proposal_propagation"] == pytest.approx(0.002)
+        assert t.stages["quorum_tail"] == pytest.approx(0.020)
+        assert t.stages["qc_verify"] == pytest.approx(0.005)
+        assert t.stages["wal_fsync"] == pytest.approx(0.004)
+        assert t.stages["commit"] == pytest.approx(0.011)
+
+    def test_missing_events_fall_back_to_commit_stage(self):
+        """A trace with only enter + commit (no proposal receipt, no
+        quorum crossing) still partitions: everything lands in the
+        commit stage and the shares still sum to 1.0."""
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 1, 10.0)
+        tr.on_commit(NODE, 1, 10.5)
+        t = tr.completed[0]
+        assert sum(t.shares.values()) == pytest.approx(1.0)
+        assert t.stages["commit"] == pytest.approx(0.5)
+
+    def test_zero_total_assigns_commit_share(self):
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 1, 10.0)
+        tr.on_commit(NODE, 1, 10.0)
+        t = tr.completed[0]
+        assert t.shares["commit"] == 1.0
+        assert sum(t.shares.values()) == pytest.approx(1.0)
+
+    def test_out_of_order_clocks_clamp_nonnegative(self):
+        """Proposal receipt stamped after commit and a quorum stamped
+        before the proposal must clamp monotone: no negative stages,
+        shares still a partition."""
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 2, 50.0)
+        tr.on_recv(NODE, _proposal(2), 51.0, None)   # after commit below
+        tr.on_quorum(NODE, VoteType.PRECOMMIT, 2, 0, 50.1, votes=3)
+        tr.on_commit(NODE, 2, 50.4)
+        t = tr.completed[0]
+        assert all(v >= 0.0 for v in t.stages.values()), t.stages
+        assert sum(t.stages.values()) == pytest.approx(t.total_s)
+        assert sum(t.shares.values()) == pytest.approx(1.0)
+
+    def test_measured_crypto_and_wal_clamp_to_tail(self):
+        """agg/qc-verify/WAL seconds larger than the post-quorum tail
+        (overlapped work) are clamped so the partition stays exact."""
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 3, 0.0)
+        tr.on_recv(NODE, _proposal(3), 0.010, None)
+        tr.on_quorum(NODE, VoteType.PRECOMMIT, 3, 0, 0.020, votes=3)
+        tr.on_qc_verify(NODE, 3, 1.0)    # way past the 10 ms tail
+        tr.on_wal_save(NODE, 3, 1.0)
+        tr.on_commit(NODE, 3, 0.030)
+        t = tr.completed[0]
+        assert t.stages["qc_verify"] == pytest.approx(0.010)
+        assert t.stages["wal_fsync"] == pytest.approx(0.0)
+        assert t.stages["commit"] == pytest.approx(0.0)
+        assert sum(t.shares.values()) == pytest.approx(1.0)
+
+    def test_nonleader_qc_receipt_ends_quorum_tail(self):
+        """A non-leader has no on_quorum crossing: the precommit QC's
+        arrival (AggregatedVote via on_recv) ends the quorum tail.
+        Prevote QCs and nil QCs must not."""
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 7, 0.0)
+        tr.on_recv(NODE, _proposal(7), 0.010, None)
+        tr.on_recv(NODE, _qc(7, vote_type=VoteType.PREVOTE), 0.015, None)
+        tr.on_recv(NODE, _qc(7, block_hash=b""), 0.018, None)
+        assert tr._pending[(NODE, 7)].t_quorum is None
+        tr.on_recv(NODE, _qc(7), 0.020, None)
+        assert tr._pending[(NODE, 7)].t_quorum == 0.020
+        tr.on_commit(NODE, 7, 0.030)
+        assert tr.completed[0].stages["quorum_tail"] == pytest.approx(0.010)
+
+    def test_first_quorum_stamp_wins(self):
+        """The leader's own (2f+1)-th-vote crossing precedes any QC
+        echo; a later receipt must not move the stamp."""
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 4, 0.0)
+        tr.on_quorum(NODE, VoteType.PRECOMMIT, 4, 0, 0.010, votes=3)
+        tr.on_recv(NODE, _qc(4), 0.025, None)
+        assert tr._pending[(NODE, 4)].t_quorum == 0.010
+
+    def test_height_settled_finalizes_once(self):
+        """Followers finalize at the status push (path="status"); a
+        node whose on_commit already fired ignores the later settle —
+        first pop wins, no double-count."""
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 6, 0.0)
+        tr.on_height_settled(NODE, 6, 0.5)
+        assert tr.completed[0].path == "status"
+        tr.on_enter_height(PEER, 6, 0.0)
+        tr.on_commit(PEER, 6, 0.3)
+        tr.on_height_settled(PEER, 6, 0.5)
+        assert len(tr.completed) == 2
+        assert tr.completed[1].path == "commit"
+        assert tr.completed[1].total_s == pytest.approx(0.3)
+
+    def test_verify_round_ids_join_the_profile_ring(self):
+        """The frontier's aggregate-path round ids recorded during the
+        interval ride the trace as verify_round_ids — the join key into
+        the device-profile ring."""
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 8, 0.0)
+        tr.on_aggregate(NODE, 8, 0.001, round_id=41)
+        tr.on_qc_verify(NODE, 8, 0.002, round_id=42)
+        tr.on_qc_verify(NODE, 8, 0.001)  # host path: no ring to join
+        tr.on_commit(NODE, 8, 0.050)
+        t = tr.completed[0]
+        assert t.verify_round_ids == (41, 42)
+        assert t.as_dict()["verify_round_ids"] == [41, 42]
+
+    def test_frontier_aggregate_paths_are_round_tagged(self):
+        """crypto/tenancy.py round-tags verify_aggregated/aggregate like
+        every flush and exposes the id (last_agg_round_id), so the
+        engine can link the trace's qc_verify stage."""
+        from consensus_overlord_tpu.crypto.frontier import BatchingVerifier
+        from consensus_overlord_tpu.crypto.provider import sim_crypto
+        from consensus_overlord_tpu.obs.fleet import current_round_id
+
+        async def main():
+            crypto = sim_crypto(b"\x01" * 32)
+            seen = []
+            orig = crypto.verify_aggregated_signature
+
+            def spy(sig, h, voters):
+                seen.append(current_round_id())
+                return orig(sig, h, voters)
+
+            crypto.verify_aggregated_signature = spy
+            fr = BatchingVerifier(crypto, max_batch=4)
+            try:
+                assert fr.last_agg_round_id is None
+                await fr.verify_aggregated(b"\x00" * 96, b"\x11" * 32,
+                                           [crypto.pub_key])
+                assert fr.last_agg_round_id is not None
+                # The dispatch thread ran under that same round tag.
+                assert seen == [fr.last_agg_round_id]
+            finally:
+                fr.close()
+        run(main())
+
+    def test_stale_pending_traces_pruned(self):
+        """A node that resynced past a height never commits it; its
+        open trace must not leak (soak-safe memory)."""
+        tr = CommitTracer()
+        tr.on_enter_height(NODE, 1, 0.0)
+        tr.on_enter_height(NODE, 2, 1.0)
+        tr.on_enter_height(NODE, 10, 2.0)
+        keys = [h for (n, h) in tr._pending if n == NODE]
+        assert keys == [10]
+
+
+class TestTraceId:
+    def test_deterministic_and_height_keyed(self):
+        assert height_trace_id(42) == height_trace_id(42)
+        assert height_trace_id(42) != height_trace_id(43)
+        assert 0 < height_trace_id(1) < (1 << 128)
+
+
+class TestAggregates:
+    def _commit(self, tr, height, total, t0=0.0):
+        tr.on_enter_height(NODE, height, t0)
+        tr.on_commit(NODE, height, t0 + total)
+
+    def test_summary_shape_and_quantiles(self):
+        tr = CommitTracer()
+        for i, total in enumerate([0.010, 0.020, 0.030, 0.040]):
+            self._commit(tr, i + 1, total)
+        s = tr.summary()
+        assert s["commits"] == 4 and s["open"] == 0
+        assert s["last_height"] == 4
+        assert s["p50_ms"] == pytest.approx(30.0)
+        assert s["p99_ms"] == pytest.approx(40.0)
+        assert set(s["stage_shares"]) == set(STAGES)
+        assert sum(s["stage_shares"].values()) == pytest.approx(1.0, abs=1e-4)
+        # statusz is the same document (the /statusz "commits" section).
+        assert tr.statusz() == s
+
+    def test_drift_ratio_gates_like_rss(self):
+        tr = CommitTracer()
+        assert tr.drift_ratio() is None
+        for i in range(8):
+            self._commit(tr, i, 0.010)
+        assert tr.drift_ratio(min_samples=8) is None  # halves too small
+        for i in range(8, 16):
+            self._commit(tr, i, 0.030)
+        ratio = tr.drift_ratio(min_samples=8)
+        assert ratio == pytest.approx(3.0, rel=0.01)
+
+
+class _CollectExporter:
+    def __init__(self):
+        self.spans = []
+
+    def report(self, span):
+        self.spans.append(span)
+
+
+class TestExports:
+    def _trace_one(self, tr, node, height, t0):
+        tr.on_enter_height(node, height, t0)
+        tr.on_recv(node, _proposal(height), t0 + 0.010,
+                   (t0 + 0.001, t0 + 0.004, t0 + 0.003, t0 + 0.010, True))
+        tr.on_quorum(node, VoteType.PRECOMMIT, height, 0, t0 + 0.030, 3)
+        tr.on_commit(node, height, t0 + 0.050)
+
+    def test_jaeger_spans_join_one_cross_node_trace(self):
+        """Two validators committing the same height export spans under
+        ONE height-derived trace id, each tagged with its node address —
+        the cross-node trace-context propagation contract."""
+        exp = _CollectExporter()
+        tr = CommitTracer(exporter=exp)
+        self._trace_one(tr, NODE, 9, 100.0)
+        self._trace_one(tr, PEER, 9, 100.0)
+        # 1 root + len(STAGES) children per node.
+        assert len(exp.spans) == 2 * (1 + len(STAGES))
+        tids = {s.trace_id for s in exp.spans}
+        assert tids == {height_trace_id(9)}
+        nodes = {s.tags["node"] for s in exp.spans}
+        assert nodes == {NODE.hex(), PEER.hex()}
+        roots = [s for s in exp.spans if s.operation == "commit.height"]
+        assert len(roots) == 2
+        root_ids = {s.span_id for s in roots}
+        for s in exp.spans:
+            if s.operation != "commit.height":
+                assert s.operation.startswith("commit.")
+                assert s.parent_span_id in root_ids
+                assert s.tags["stage"] in STAGES
+
+    def test_perfetto_doc_loads_and_carries_critpath(self):
+        tr = CommitTracer()
+        self._trace_one(tr, NODE, 1, 10.0)
+        self._trace_one(tr, PEER, 2, 10.1)
+        doc = json.loads(json.dumps(tr.to_perfetto()))
+        evs = doc["traceEvents"]
+        assert any(e["ph"] == "X" and e["cat"] == "commit" for e in evs)
+        assert any(e["ph"] == "X" and e["cat"] == "critpath" for e in evs)
+        assert any(e["ph"] == "M" for e in evs)  # process names
+        traces = doc["critpath"]["traces"]
+        assert len(traces) == 2
+        for t in traces:
+            assert sum(t["shares"].values()) == pytest.approx(1.0)
+        assert doc["critpath"]["summary"]["commits"] == 2
+
+
+class TestWaterfallCritpath:
+    """scripts/waterfall.py --critical-path (satellite: per-height stage
+    bars, critical stage highlighted, --json, exit 5 on no data)."""
+
+    def _dump(self, tmp_path, tracer):
+        path = tmp_path / "cp.json"
+        path.write_text(json.dumps(tracer.to_perfetto()))
+        return path
+
+    def _tracer_with_commits(self):
+        tr = CommitTracer()
+        for h in (1, 2, 3):
+            tr.on_enter_height(NODE, h, float(h))
+            tr.on_recv(NODE, _proposal(h), h + 0.010,
+                       (h + 0.001, h + 0.004, h + 0.003, h + 0.010, True))
+            tr.on_quorum(NODE, VoteType.PRECOMMIT, h, 0, h + 0.030, 3)
+            tr.on_commit(NODE, h, h + 0.050)
+        return tr
+
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, str(WATERFALL), *argv],
+            capture_output=True, text=True, timeout=60)
+
+    def test_reconstructs_every_traced_height(self, tmp_path):
+        path = self._dump(tmp_path, self._tracer_with_commits())
+        text = self._run("--critical-path", str(path))
+        assert text.returncode == 0, text.stderr
+        assert "height 1" in text.stdout and "*" in text.stdout
+        js = self._run("--critical-path", str(path), "--json")
+        assert js.returncode == 0, js.stderr
+        doc = json.loads(js.stdout)
+        assert doc["count"] == 3 and doc["traces"] == 3
+        assert [h["height"] for h in doc["heights"]] == [1, 2, 3]
+        for h in doc["heights"]:
+            for t in h["traces"]:
+                crit = [s for s in t["segments"] if s["critical"]]
+                assert len(crit) == 1  # exactly one dominant stage
+                assert t["via_trunk"] is True
+                starts = [s["start_s"] for s in t["segments"]]
+                assert starts == sorted(starts)
+
+    def test_exit_5_on_no_commit_tagged_data(self, tmp_path):
+        path = tmp_path / "empty.json"
+        path.write_text(json.dumps({"traceEvents": [],
+                                    "critpath": {"traces": []}}))
+        r = self._run("--critical-path", str(path))
+        assert r.returncode == 5
+        assert "no commit-tagged data" in r.stderr
+        # Distinct from the round mode's exit 4.
+        r4 = self._run(str(path))
+        assert r4.returncode == 4
+
+
+class TestFabricEndToEnd:
+    """The envelope fabric wired through a live fleet."""
+
+    def test_golden_fixtures_byte_identical(self):
+        """The tracer costs the fabric zero RNG draws: the seed-7 golden
+        fixtures pinned by the sharded-fabric and chaos suites must stay
+        byte-for-byte what they were before the envelope threading."""
+        pins = {
+            "router_golden_seed7.json":
+                "58e89ace54155c3bff30bf1f67bb9a7b"
+                "91a2f2febe13b904b2367b1459db78e7",
+            "chaos_schedule_seed7.json":
+                "77994828ae332ee18d1f27a4dea43aa5"
+                "b058ad2e33c4139313fc44355d769261",
+        }
+        for name, want in pins.items():
+            got = hashlib.sha256((DATA / name).read_bytes()).hexdigest()
+            assert got == want, f"{name} changed: {got}"
+
+    def test_traces_cross_trunk_and_survive_restart(self):
+        """8 validators on a 4-shard fabric: commit traces must flow,
+        inter-shard proposals must show via_trunk provenance, and the
+        revived node's traces must keep arriving after restart_node —
+        trace-context propagation survives the crash/revive cycle."""
+        from consensus_overlord_tpu.sim import SimNetwork
+
+        async def main():
+            tracer = CommitTracer()
+            net = SimNetwork(n_validators=8, block_interval_ms=50,
+                             seed=7, shards=4, causal=tracer)
+            net.start(init_height=1)
+            await net.run_until_height(3)
+            victim = net.nodes[2]
+            await victim.stop()
+            await net.run_until_height(net.controller.latest_height + 2)
+            revived = net.restart_node(2)
+            revived.start(net.controller.latest_height + 1,
+                          net.controller.block_interval_ms,
+                          net.controller.authority_list())
+            restart_floor = net.controller.latest_height
+            await net.run_until_height(restart_floor + 3, timeout=30)
+            await asyncio.sleep(0.3)
+            await net.stop()
+
+            traces = list(tracer.completed)
+            assert traces, "no commit traces assembled"
+            for t in traces:
+                assert sum(t.shares.values()) == pytest.approx(1.0)
+                assert sum(t.stages.values()) == pytest.approx(t.total_s)
+            # Both settle paths show up: the relayer's own adapter
+            # commit and the status-push follower traces.
+            assert {t.path for t in traces} == {"commit", "status"}
+            # 4 shards: proposals reaching off-shard validators carry
+            # trunk provenance (the leader's own trace never does).
+            assert any(t.via_trunk for t in traces)
+            assert net.router.stats()["trunk_msgs"] > 0
+            # The revived engine kept reporting into the shared tracer.
+            revived_heights = [t.height for t in traces
+                               if t.node == revived.name.hex()]
+            assert revived_heights
+            assert max(revived_heights) > restart_floor
+            s = tracer.summary()
+            assert s["commits"] == len(traces)
+            assert s["p50_ms"] > 0
+        run(main())
